@@ -1,0 +1,42 @@
+"""accelerate_tpu — a TPU-native training portability framework.
+
+A ground-up JAX/XLA re-design with the capability surface of HuggingFace
+Accelerate (studied at /root/reference, see SURVEY.md): one ``Accelerator``
+façade, a ``ParallelismConfig`` → GSPMD device mesh, every parallelism
+strategy (DP / ZeRO-FSDP / HSDP / TP / CP ring attention / Ulysses SP / EP /
+PP) expressed as NamedSharding choices with XLA collectives over ICI/DCN,
+bf16/fp8 precision policies, distributed data loading, checkpoint/resume,
+experiment tracking, big-model inference with host offload, and an
+``accelerate``-style CLI.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .parallelism_config import ParallelismConfig, build_mesh_from_env
+from .logging import get_logger
+from .utils import (
+    DataLoaderConfiguration,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ProjectConfiguration,
+    find_executable_batch_size,
+    set_seed,
+)
+
+# Imported lazily below to keep `import accelerate_tpu` light; these modules
+# pull in flax/optax.
+from .model import Model  # noqa: E402
+from .accelerator import Accelerator  # noqa: E402
+from .data_loader import (  # noqa: E402
+    BatchSamplerShard,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from .optimizer import AcceleratedOptimizer  # noqa: E402
+from .scheduler import AcceleratedScheduler  # noqa: E402
+from .train_state import TrainState  # noqa: E402

@@ -1,0 +1,231 @@
+"""Training-state checkpointing (layer L8).
+
+Reference: src/accelerate/checkpointing.py:63-341 + accelerator.py:3584-3748.
+Directory contract mirrors the reference: per checkpoint dir —
+``model.safetensors`` (fp32 master params, name-keyed), ``optimizer.bin``,
+``scheduler.bin``, ``sampler.bin``, ``random_states_<rank>.pkl``, plus
+``custom_checkpoint_<i>.pkl`` for registered objects. Param/optimizer identity
+is by *name* (flattened "/"-paths), never object id, so checkpoints survive
+resharding — load into any mesh shape and every leaf lands back through its
+planned NamedSharding (SURVEY.md §7 hard-part 5).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .utils.constants import (
+    MODEL_NAME,
+    OPTIMIZER_NAME,
+    RNG_STATE_NAME,
+    SAMPLER_NAME,
+    SCALER_NAME,
+    SCHEDULER_NAME,
+)
+from .utils.operations import to_global_host
+from .utils.other import (
+    flatten_state_dict,
+    load_sharded_safetensors,
+    save_sharded_safetensors,
+    unflatten_state_dict,
+)
+from .utils.random import load_rng_state, rng_state
+
+logger = get_logger(__name__)
+
+
+def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = False) -> str:
+    pc = accelerator.project_configuration
+    if pc.automatic_checkpoint_naming and output_dir is None:
+        base = os.path.join(accelerator.project_dir or ".", "checkpoints")
+        if for_load:
+            folders = sorted(
+                (f for f in os.listdir(base) if f.startswith("checkpoint_")),
+                key=lambda f: int(f.split("_")[1]),
+            )
+            if not folders:
+                raise FileNotFoundError(f"No checkpoints found in {base}")
+            return os.path.join(base, folders[-1])
+        out = os.path.join(base, f"checkpoint_{pc.iteration}")
+        return out
+    if output_dir is None:
+        raise ValueError("Provide output_dir or enable automatic_checkpoint_naming.")
+    return output_dir
+
+
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+    pc = accelerator.project_configuration
+    output_dir = _checkpoint_dir(accelerator, output_dir)
+    if pc.automatic_checkpoint_naming and accelerator.is_main_process:
+        base = os.path.dirname(output_dir)
+        os.makedirs(base, exist_ok=True)
+        existing = sorted(
+            (f for f in os.listdir(base) if f.startswith("checkpoint_")),
+            key=lambda f: int(f.split("_")[1]),
+        )
+        # total_limit pruning (reference: accelerator.py:3622-3647).
+        if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
+            import shutil
+
+            for f in existing[: len(existing) + 1 - pc.total_limit]:
+                shutil.rmtree(os.path.join(base, f), ignore_errors=True)
+    accelerator.wait_for_everyone()
+    os.makedirs(output_dir, exist_ok=True)
+
+    state = accelerator._train_state
+    if state is None:
+        raise RuntimeError("Nothing prepared; call accelerator.prepare(...) first.")
+
+    # Model params → name-keyed safetensors (fp32 masters, gathered to host).
+    params_host = to_global_host(state.params)
+    if accelerator.is_main_process:
+        save_sharded_safetensors(flatten_state_dict(params_host), output_dir, weights_name=f"{MODEL_NAME}.safetensors")
+
+    # Optimizer state: flattened name-keyed arrays + treedef-free aux.
+    opt_host = jax.tree.map(
+        lambda x: to_global_host(x) if hasattr(x, 'shape') else x, state.opt_state
+    )
+    step_host = int(np.asarray(state.step))
+    if accelerator.is_main_process:
+        with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
+            pickle.dump({"opt_state": opt_host, "step": step_host}, f)
+        if state.loss_scale is not None:
+            with open(os.path.join(output_dir, f"{SCALER_NAME}.bin"), "wb") as f:
+                pickle.dump(
+                    {
+                        "scale": float(np.asarray(state.loss_scale.scale)),
+                        "growth_tracker": int(np.asarray(state.loss_scale.growth_tracker)),
+                    },
+                    f,
+                )
+        for i, scheduler in enumerate(accelerator._schedulers):
+            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
+                pickle.dump(scheduler.state_dict(), f)
+        for i, dl in enumerate(accelerator._dataloaders):
+            sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
+            sampler = getattr(sampler, "sampler", None) or getattr(
+                getattr(dl, "batch_sampler", None), "sampler", None
+            )
+            if sampler is not None and hasattr(sampler, "state_dict"):
+                with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
+                    pickle.dump(sampler.state_dict(), f)
+        for i, obj in enumerate(accelerator._custom_objects):
+            with open(os.path.join(output_dir, f"custom_checkpoint_{i}.pkl"), "wb") as f:
+                pickle.dump(obj.state_dict(), f)
+        with open(os.path.join(output_dir, "accelerator_step.bin"), "wb") as f:
+            pickle.dump({"step": accelerator.step}, f)
+
+    # Per-rank RNG state (reference: checkpointing.py:154-179).
+    with open(
+        os.path.join(output_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"), "wb"
+    ) as f:
+        pickle.dump(rng_state(), f)
+
+    if pc.automatic_checkpoint_naming:
+        pc.iteration += 1
+    accelerator.wait_for_everyone()
+    logger.info(f"Saved accelerator state to {output_dir}", main_process_only=True)
+    return output_dir
+
+
+def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
+    input_dir = _checkpoint_dir(accelerator, input_dir, for_load=True)
+    state = accelerator._train_state
+    if state is None:
+        raise RuntimeError("Call accelerator.prepare(...) before load_state().")
+
+    flat = load_sharded_safetensors(input_dir, weights_name=f"{MODEL_NAME}.safetensors")
+    loaded_tree = unflatten_state_dict(flat)
+
+    # Re-map by name into the live (sharded) param structure.
+    def _remap(current, new):
+        if isinstance(current, dict):
+            return {k: _remap(v, new[k]) for k, v in current.items()}
+        return np.asarray(new).reshape(current.shape)
+
+    params_host = _remap(jax.tree.map(lambda x: x, state.params), loaded_tree)
+    shardings = accelerator._state_shardings
+    new_params = jax.tree.map(
+        lambda arr, s: jax.device_put(arr, s), params_host, shardings.params
+    )
+
+    with open(os.path.join(input_dir, f"{OPTIMIZER_NAME}.bin"), "rb") as f:
+        opt_payload = pickle.load(f)
+    new_opt = jax.tree.map(
+        lambda arr, s: jax.device_put(np.asarray(arr), s)
+        if hasattr(arr, "shape") or np.isscalar(arr)
+        else arr,
+        opt_payload["opt_state"],
+        shardings.opt_state,
+    )
+    loss_scale = state.loss_scale
+    scaler_path = os.path.join(input_dir, f"{SCALER_NAME}.bin")
+    if loss_scale is not None and os.path.exists(scaler_path):
+        import jax.numpy as jnp
+
+        with open(scaler_path, "rb") as f:
+            sc = pickle.load(f)
+        loss_scale = loss_scale.replace(
+            scale=jnp.asarray(sc["scale"], jnp.float32),
+            growth_tracker=jnp.asarray(sc["growth_tracker"], jnp.int32),
+        )
+
+    import jax.numpy as jnp
+
+    accelerator._train_state = state.replace(
+        step=jnp.asarray(opt_payload["step"], jnp.int32),
+        params=new_params,
+        opt_state=new_opt,
+        loss_scale=loss_scale,
+    )
+
+    for i, scheduler in enumerate(accelerator._schedulers):
+        path = os.path.join(input_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                scheduler.load_state_dict(pickle.load(f))
+    for i, dl in enumerate(accelerator._dataloaders):
+        path = os.path.join(input_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin")
+        if os.path.exists(path):
+            sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
+            sampler = getattr(sampler, "sampler", None) or getattr(
+                getattr(dl, "batch_sampler", None), "sampler", None
+            )
+            if sampler is not None and hasattr(sampler, "load_state_dict"):
+                with open(path, "rb") as f:
+                    sampler.load_state_dict(pickle.load(f))
+    for i, obj in enumerate(accelerator._custom_objects):
+        path = os.path.join(input_dir, f"custom_checkpoint_{i}.pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                obj.load_state_dict(pickle.load(f))
+    step_path = os.path.join(input_dir, "accelerator_step.bin")
+    if os.path.exists(step_path):
+        with open(step_path, "rb") as f:
+            accelerator.step = pickle.load(f)["step"]
+
+    rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl")
+    if os.path.exists(rng_path):
+        with open(rng_path, "rb") as f:
+            load_rng_state(pickle.load(f))
+
+    logger.info(f"Loaded accelerator state from {input_dir}", main_process_only=True)
+    return input_dir
+
+
+def save_custom_state(obj, path: str, index: int = 0):
+    """(reference: checkpointing.py:323-332)"""
+    with open(os.path.join(path, f"custom_checkpoint_{index}.pkl"), "wb") as f:
+        pickle.dump(obj.state_dict(), f)
+
+
+def load_custom_state(obj, path: str, index: int = 0):
+    """(reference: checkpointing.py:334-341)"""
+    with open(os.path.join(path, f"custom_checkpoint_{index}.pkl"), "rb") as f:
+        obj.load_state_dict(pickle.load(f))

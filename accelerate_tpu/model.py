@@ -1,0 +1,122 @@
+"""User-facing model bundle.
+
+The reference's ``prepare_model`` wraps a torch ``nn.Module`` in place
+(reference: accelerator.py:1769-2066). JAX separates architecture (pure apply
+function) from state (param pytree); :class:`Model` is the thin bundle that
+carries both through ``Accelerator.prepare`` so the user-visible flow keeps
+the reference's shape::
+
+    model = Model.from_flax(module, rng, sample_batch)     # or Model(apply_fn, params)
+    model, optimizer, loader = accelerator.prepare(model, tx, loader)
+    logits = model(batch)                                   # eval/inference call
+
+After prepare, ``model.params`` is a view onto the accelerator's canonical
+sharded TrainState — the same single-source-of-truth rule the reference
+enforces by mutating the module in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+class Model:
+    def __init__(
+        self,
+        apply_fn: Callable = None,
+        params: Any = None,
+        extra_state: Any = None,
+        module: Any = None,
+        tp_rules: Optional[list] = None,
+    ):
+        if apply_fn is None and module is None:
+            raise ValueError("Provide apply_fn or module")
+        self.module = module
+        if apply_fn is None:
+            apply_fn = module.apply
+        self.apply_fn = apply_fn
+        self._params = params
+        self.extra_state = extra_state
+        # Optional tensor-parallel rule table: [(name_regex, PartitionSpec)].
+        self.tp_rules = tp_rules or list(getattr(module, "tp_rules", []) or [])
+        self._accelerator = None
+        self._accelerate_prepared = False
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def from_flax(cls, module, rng, *sample_args, tp_rules=None, **sample_kwargs) -> "Model":
+        """Initialize a flax.linen module and bundle it."""
+        variables = module.init(rng, *sample_args, **sample_kwargs)
+        variables = dict(variables)
+        params = variables.pop("params")
+        extra = variables or None
+        return cls(module=module, params=params, extra_state=extra, tp_rules=tp_rules)
+
+    # -- state access ----------------------------------------------------
+
+    @property
+    def params(self):
+        if self._accelerator is not None and self._accelerator._train_state is not None:
+            return self._accelerator._train_state.params
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        if self._accelerator is not None and self._accelerator._train_state is not None:
+            self._accelerator._train_state = self._accelerator._train_state.replace(params=value)
+        else:
+            self._params = value
+
+    def parameters(self):
+        """torch-parity iterator over param leaves."""
+        return iter(jax.tree.leaves(self.params))
+
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+
+    def state_dict(self):
+        from .utils.other import flatten_state_dict
+
+        return flatten_state_dict(self.params)
+
+    def load_state_dict(self, flat: dict):
+        from .utils.other import unflatten_state_dict
+
+        tree = unflatten_state_dict({k: v for k, v in flat.items()})
+        # Re-map by name into the existing structure to preserve treedef/dtypes.
+        current = self.params
+
+        def _remap(path_tree, new_tree):
+            if isinstance(path_tree, dict):
+                return {k: _remap(v, new_tree.get(k)) for k, v in path_tree.items()}
+            if new_tree is None:
+                raise KeyError("Missing key in loaded state dict")
+            import jax.numpy as jnp
+
+            return jnp.asarray(new_tree, dtype=path_tree.dtype).reshape(path_tree.shape)
+
+        self.params = _remap(current, tree)
+
+    # -- forward ---------------------------------------------------------
+
+    def __call__(self, *args, rngs=None, train: bool = False, **kwargs):
+        variables = {"params": self.params}
+        extra = self.extra_state
+        if self._accelerator is not None and self._accelerator._train_state is not None:
+            extra = self._accelerator._train_state.extra_state
+        if extra:
+            variables.update(extra)
+        call_kwargs = dict(kwargs)
+        if rngs is not None:
+            call_kwargs["rngs"] = rngs
+        return self.apply_fn(variables, *args, **call_kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self

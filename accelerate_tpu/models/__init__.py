@@ -1,0 +1,7 @@
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    cross_entropy_loss,
+    llama_tp_rules,
+)

@@ -1,0 +1,291 @@
+"""Llama-family decoder, TPU-first.
+
+The reference framework does not ship models (it wraps user torch modules +
+transformers' ``tp_plan``, reference: accelerator.py:1580-1656); a TPU-native
+framework must own the TP rule tables and the flagship architecture used by
+its benchmarks (BASELINE.json: FSDP2 Llama-7B tokens/sec/chip). Design points:
+
+- **MXU-shaped**: all projections are single large matmuls in bf16; head dim
+  128 (= MXU lane width); no per-head Python loops.
+- **scan over layers**: identical blocks rolled into one ``nn.scan`` — one
+  trace/compile of the block instead of L (the analog of the reference's
+  "regional compilation", utils/other.py:106-177, its 5-9× compile win).
+- **remat**: optional ``nn.remat`` on the block to trade FLOPs for HBM.
+- **TP rules**: Megatron-style column/row parallel table as name-regex →
+  PartitionSpec over the ``tp`` mesh axis; composes with FSDP sharding of the
+  remaining dim (parallel/sharding.py).
+- **attention seam**: the inner attention call dispatches on the active mesh
+  (cp → ring attention, sp → Ulysses all-to-all, else flash/native) so the
+  same module serves all sequence-parallel modes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16          # compute dtype (params stay fp32 masters)
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "native"      # native | flash | ring | ulysses
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(
+            vocab_size=256, hidden_size=128, intermediate_size=384,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512, **kw,
+        )
+
+    @classmethod
+    def llama_7b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def llama_1b(cls, **kw):
+        return cls(
+            hidden_size=2048, intermediate_size=5504, num_hidden_layers=16,
+            num_attention_heads=16, num_key_value_heads=16, **kw,
+        )
+
+
+def rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return rms_norm(x, weight.astype(x.dtype), self.eps)
+
+
+def rotary_embedding(positions: jax.Array, head_dim: int, theta: float, dtype) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for RoPE, computed on the fly (cheap, fuses)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, D); cos/sin: (B, S, D) or (S, D)."""
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+def naive_attention(q, k, v, *, causal: bool = True, segment_positions=None):
+    """Reference attention in pure jnp — correct under GSPMD for dp/tp/fsdp.
+    q: (B, S, Hq, D); k/v: (B, S, Hkv, D). GQA via head repetition (XLA turns
+    the broadcast into an efficient layout, no materialized copy)."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _dispatch_attention(impl: str):
+    if impl in ("native",):
+        return naive_attention
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention
+    if impl == "ring":
+        from ..parallel.cp import ring_attention
+
+        return ring_attention
+    if impl == "ulysses":
+        from ..parallel.sp import ulysses_attention
+
+        return ulysses_attention
+    raise ValueError(f"Unknown attention_impl {impl}")
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        d = cfg.head_dim
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        q = dense(features=(cfg.num_attention_heads, d), name="q_proj")(x)
+        k = dense(features=(cfg.num_key_value_heads, d), name="k_proj")(x)
+        v = dense(features=(cfg.num_key_value_heads, d), name="v_proj")(x)
+        cos, sin = rotary_embedding(positions, d, cfg.rope_theta, x.dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn_fn = _dispatch_attention(cfg.attention_impl)
+        out = attn_fn(q, k, v, causal=True)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="o_proj",
+        )(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x), positions
+        )
+        out = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, name="post_attention_layernorm")(h)
+        )
+        return out
+
+
+class _ScannedBlock(nn.Module):
+    """LlamaBlock wrapped for nn.scan: carry = hidden states."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        x = LlamaBlock(self.config, name="block")(x, positions)
+        return (x, positions), None
+
+
+class LlamaModel(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="embed_tokens",
+        )(input_ids)
+        positions = jnp.arange(input_ids.shape[-1])[None, :].astype(jnp.int32)
+        positions = jnp.broadcast_to(positions, input_ids.shape)
+        if cfg.scan_layers:
+            block = _ScannedBlock
+            if cfg.remat:
+                block = nn.remat(block, prevent_cse=False)
+            scanned = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            (x, _), _ = scanned((x, positions), None)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                blk = LlamaBlock
+                if cfg.remat:
+                    blk = nn.remat(blk, prevent_cse=False)
+                x = blk(cfg, name=f"layers_{i}")(x, positions)
+        return RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = LlamaModel(cfg, name="model")(input_ids)
+        if cfg.tie_word_embeddings:
+            embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
+            return x @ embed.T.astype(cfg.dtype)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="lm_head",
+        )(x)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel rule table (the role of transformers' tp_plan, owned
+# in-framework per SURVEY.md §7 hard-part 3). Regexes match "/"-joined param
+# paths; specs are dim-aligned with the param shapes. With scan_layers the
+# block params gain a leading layer dim, hence the leading None.
+# ---------------------------------------------------------------------------
+
+def llama_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    lead = (None,) if scan_layers else ()
+    rules = [
+        # Column-parallel: shard heads/ffn (output) dim.
+        (r"self_attn/(q_proj|k_proj|v_proj)/kernel", lead + (None, "tp", None)),
+        (r"mlp/(gate_proj|up_proj)/kernel", lead + (None, "tp")),
+        # Row-parallel: shard input dim; XLA inserts the psum on the output.
+        (r"self_attn/o_proj/kernel", lead + ("tp", None, None)),
+        (r"mlp/down_proj/kernel", lead + ("tp", None)),
+        # Embedding + head sharded on vocab.
+        (r"embed_tokens/embedding", ("tp", None)),
+        (r"lm_head/kernel", (None, "tp")),
+    ]
+    return [(pat, P(*spec) if isinstance(spec, tuple) else spec) for pat, spec in rules]
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Token-level CE with masking — computed in fp32 regardless of compute
+    dtype (loss reductions always fp32 on TPU to avoid bf16 accumulation
+    error)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    token_loss = jnp.where(valid, token_loss, 0.0)
+    return token_loss.sum() / jnp.maximum(valid.sum(), 1)

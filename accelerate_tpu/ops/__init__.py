@@ -1,0 +1,1 @@
+from .flash_attention import blockwise_attention, flash_attention

@@ -1,0 +1,152 @@
+"""Memory-efficient attention.
+
+The reference delegates fused attention to SDPA/FlashAttention-2/3 via torch
+(reference: SURVEY.md §2.3 CP/SP rows). Here:
+
+- :func:`blockwise_attention` — online-softmax attention as a ``lax.scan``
+  over KV blocks. Pure jnp, runs on every backend, O(S·B_k) memory instead of
+  O(S²); this is what lets seq-2048×16-layer training fit a 16GB v5e chip
+  without remat.
+- :func:`flash_attention` — dispatcher: the Pallas TPU kernel when available
+  (ops/pallas_flash.py), else the blockwise fallback.
+
+Both support GQA (Hq a multiple of Hkv) and causal masking with query/key
+position offsets (needed by ring attention's rotated chunks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k, v, hq):
+    hkv = k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset=0,
+    k_offset=0,
+    block_k: int = 512,
+):
+    """Online-softmax attention, scanning KV blocks.
+
+    q: (B, Sq, Hq, D); k/v: (B, Sk, Hkv, D). ``q_offset``/``k_offset`` are the
+    global positions of element 0 of q/k — chunk-local attention inside ring
+    attention passes these (they may be traced values).
+    Returns (B, Sq, Hq, D).
+    """
+    b, sq, hq, d = q.shape
+    k, v = _repeat_kv(k, v, hq)
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    num_blocks = (sk + block_k - 1) // block_k
+    pad = num_blocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (n_blocks, B, block_k, H, D)
+    kb = k.reshape(b, num_blocks, block_k, hq, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, num_blocks, block_k, hq, d).transpose(1, 0, 2, 3, 4)
+
+    scale = 1.0 / np.sqrt(d)
+    q_pos = q_offset + jnp.arange(sq)
+
+    def body(carry, xs):
+        acc, m, l = carry
+        blk_idx, k_blk, v_blk = xs
+        k_pos = k_offset + blk_idx * block_k + jnp.arange(block_k)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        # padding-key mask (sk = original unpadded length), then causal mask
+        valid = (blk_idx * block_k + jnp.arange(block_k)) < sk
+        logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+        if causal:
+            cmask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(cmask[None, None], logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new[..., None])
+        l_corr = l * jnp.exp(m - m_new)
+        l_new = l_corr + jnp.sum(p, axis=-1)
+        acc = acc * jnp.exp(m - m_new)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk
+        ).astype(jnp.float32)
+        return (acc, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    m0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0), (jnp.arange(num_blocks), kb, vb)
+    )
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, Sq, H, D)
+
+
+def attention_stats(q, k, v, *, causal=True, q_offset=0, k_offset=0):
+    """One-chunk attention returning ONLINE-SOFTMAX STATS instead of the
+    normalized output: (acc[B,H,Sq,D] fp32, m[B,H,Sq], l[B,H,Sq]). Ring
+    attention merges these across KV rotations."""
+    b, sq, hq, d = q.shape
+    k, v = _repeat_kv(k, v, hq)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(sq)
+    k_pos = k_offset + jnp.arange(k.shape[1])
+    if causal:
+        cmask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(cmask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m, l
+
+
+def merge_attention_stats(stats_a, stats_b):
+    """Combine two online-softmax partials over disjoint key sets."""
+    acc_a, m_a, l_a = stats_a
+    acc_b, m_b, l_b = stats_b
+    m = jnp.maximum(m_a, m_b)
+    ca = jnp.exp(m_a - m)
+    cb = jnp.exp(m_b - m)
+    return acc_a * ca[..., None] + acc_b * cb[..., None], m, l_a * ca + l_b * cb
+
+
+def finalize_attention_stats(stats, dtype):
+    acc, m, l = stats
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_k: int = 512, **kwargs):
+    """Fused attention entry point. Uses the Pallas TPU kernel on real TPU
+    backends, the blockwise jnp path elsewhere."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    if platform in ("tpu", "axon"):
+        try:
+            from .pallas_flash import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal)
+        except Exception:
+            pass
+    return blockwise_attention(q, k, v, causal=causal, block_k=block_k, **kwargs)

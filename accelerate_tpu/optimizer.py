@@ -1,0 +1,103 @@
+"""Imperative-surface optimizer wrapper (layer L4).
+
+Reference: src/accelerate/optimizer.py:38-213 — ``AcceleratedOptimizer`` no-ops
+``step``/``zero_grad`` during gradient accumulation and runs the DP all-reduce
+before stepping. Here the wrapped object is an ``optax.GradientTransformation``
+and the canonical state lives in the :class:`~accelerate_tpu.train_state.TrainState`
+held by the Accelerator; ``step()`` applies the accumulated gradients through a
+jitted update whose in/out shardings keep everything on the mesh. The DP
+gradient mean needs no explicit all-reduce: gradients come out of the jitted
+backward already psum'd by GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedOptimizer:
+    def __init__(self, optimizer, device_placement: bool = True, scaler=None, accelerator=None):
+        self.optimizer = optimizer  # the optax GradientTransformation
+        self.scaler = scaler
+        self.accelerator_state = AcceleratorState()
+        self.gradient_state = GradientState()
+        self.device_placement = device_placement
+        self._accelerator = accelerator
+        self._is_overflow = False
+        self._accumulated: Optional[Any] = None
+        self._micro_count = 0
+        self._apply_jit = None
+
+    # -- reference surface -------------------------------------------------
+
+    @property
+    def state(self):
+        if self._accelerator is not None and self._accelerator._train_state is not None:
+            return self._accelerator._train_state.opt_state
+        return None
+
+    @property
+    def param_groups(self):
+        """Minimal param_groups view for reference-parity introspection."""
+        lr = None
+        if self._accelerator is not None and self._accelerator._scheduler is not None:
+            lr = self._accelerator._scheduler.get_last_lr()
+        return [{"params": [], "lr": lr}]
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Drop the accumulation buffer. No-op mid-accumulation like the
+        reference (optimizer.py:112-124)."""
+        if self.gradient_state.sync_gradients:
+            self._accumulated = None
+            self._micro_count = 0
+
+    def accumulate_grads(self, grads):
+        """Called by ``Accelerator.backward`` with freshly computed grads."""
+        if self._accumulated is None:
+            self._accumulated = grads
+        else:
+            self._accumulated = jax.tree.map(jnp.add, self._accumulated, grads)
+        self._micro_count += 1
+
+    @property
+    def grads(self):
+        return self._accumulated
+
+    def step(self, closure=None):
+        """Apply accumulated grads when on a sync boundary; no-op otherwise
+        (reference: optimizer.py:145-177)."""
+        if not self.gradient_state.sync_gradients:
+            return
+        if self._accelerator is None:
+            raise RuntimeError(
+                "This AcceleratedOptimizer is not bound to an Accelerator; "
+                "pass it through `accelerator.prepare(...)` first."
+            )
+        if self._accumulated is None:
+            return
+        self._is_overflow = not self._accelerator._apply_gradients(self._accumulated)
+        self._accumulated = None
+        self._micro_count = 0
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """True when the last step overflowed under fp16 loss scaling
+        (reference: optimizer.py:199-204)."""
+        return self._is_overflow
+
+    def train(self):
+        pass
+
+    def eval(self):
+        pass
+
+    def __getstate__(self):
+        return self.__dict__.copy()
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)

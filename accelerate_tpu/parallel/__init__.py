@@ -1,0 +1,7 @@
+from .sharding import (
+    batch_partition_spec,
+    infer_opt_state_sharding,
+    plan_parameter_sharding,
+    named_sharding,
+    replicated,
+)

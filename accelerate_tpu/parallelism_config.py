@@ -1,0 +1,247 @@
+"""N-D parallelism configuration → one JAX device mesh.
+
+This is the keystone of the TPU-native design. The reference builds a torch
+``DeviceMesh`` with canonical dim order ``(dp_replicate, dp_shard, cp, sp, tp)``
+plus flattened joint meshes ``dp``, ``dp_shard_cp``, ``dp_cp``
+(reference: src/accelerate/parallelism_config.py:34-272). Here the same config
+surface produces a :class:`jax.sharding.Mesh`; every parallelism backend in the
+reference (DDP, FSDP1/2, HSDP, DeepSpeed-ZeRO, TP, CP, SP) becomes a
+``NamedSharding``/``PartitionSpec`` choice over these axes, and XLA's GSPMD
+partitioner inserts the collectives over ICI/DCN.
+
+Because JAX ``PartitionSpec`` accepts *tuples* of axis names, the reference's
+flattened joint meshes are zero-cost here: ``P(("dp_replicate", "dp_shard"))``
+*is* the flattened ``dp`` mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+from .utils.constants import MESH_AXIS_ORDER, PARALLELISM_CONFIG_PREFIX
+from .utils.environment import get_int_from_env, parse_choice_from_env
+
+
+@dataclasses.dataclass
+class ParallelismConfig:
+    """Degrees for every first-class parallelism axis.
+
+    Mirrors the reference's ``ParallelismConfig``
+    (reference: parallelism_config.py:34-98) with the same validation rules
+    (cp and sp mutually exclusive, reference: parallelism_config.py:328-334)
+    and adds ``pp_size`` / ``ep_size`` as first-class citizens (the reference
+    reaches pipeline and expert parallelism only through Megatron-LM,
+    SURVEY.md §2.3).
+
+    Axis semantics:
+      - ``dp_replicate``: pure data parallel (DDP-style replication).
+      - ``dp_shard``: ZeRO/FSDP-style parameter+optimizer sharding axis.
+      - ``cp``: context parallel (ring attention) — sequence sharded, KV rotated.
+      - ``sp``: Ulysses sequence parallel — heads sharded via all-to-all.
+      - ``tp``: tensor parallel — hidden dims sharded.
+      - ``ep``: expert parallel — experts sharded over the joint (dp_shard, sp, tp)
+        axes at MoE layers (no extra mesh dim needed; like torchtitan/DeepSpeed-MoE).
+      - ``pp``: pipeline parallel — model stages; implemented as a microbatch
+        schedule over mesh sub-slices, not an extra GSPMD dim.
+    """
+
+    dp_replicate_size: int = 1
+    dp_shard_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    tp_size: int = 1
+    ep_size: int = 1
+    pp_size: int = 1
+
+    # "alltoall" = ring rotation of KV blocks; "allgather" = gather full KV
+    # (reference: TorchContextParallelConfig.set_rotate_method,
+    # utils/dataclasses.py:2205-2231).
+    cp_rotate_method: str = "alltoall"
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if f.name.endswith("_size") and (not isinstance(v, int) or v < 1):
+                raise ValueError(f"{f.name} must be a positive int, got {v!r}")
+        if self.cp_size > 1 and self.sp_size > 1:
+            # Same rule as the reference (parallelism_config.py:328-334).
+            raise ValueError(
+                "cp_size and sp_size cannot both be >1: ring context-parallelism "
+                "and Ulysses sequence-parallelism are mutually exclusive."
+            )
+        if self.cp_rotate_method not in ("alltoall", "allgather"):
+            raise ValueError(f"cp_rotate_method must be alltoall|allgather, got {self.cp_rotate_method}")
+        if self.ep_size > 1 and self.ep_size > self.dp_shard_size * self.sp_size * self.tp_size:
+            raise ValueError(
+                "ep_size must divide into dp_shard*sp*tp (experts are sharded over "
+                f"those axes); got ep={self.ep_size}"
+            )
+
+    # ------------------------------------------------------------------
+    # Size properties (reference: parallelism_config.py:100-164)
+    # ------------------------------------------------------------------
+
+    @property
+    def dp_size(self) -> int:
+        return self.dp_replicate_size * self.dp_shard_size
+
+    @property
+    def dp_shard_cp_size(self) -> int:
+        return self.dp_shard_size * self.cp_size
+
+    @property
+    def dp_cp_size(self) -> int:
+        return self.dp_size * self.cp_size
+
+    @property
+    def non_pp_size(self) -> int:
+        return self.dp_cp_size * self.sp_size * self.tp_size
+
+    @property
+    def total_size(self) -> int:
+        return self.non_pp_size * self.pp_size
+
+    @property
+    def active_mesh_dims(self) -> tuple[str, ...]:
+        return tuple(ax for ax in MESH_AXIS_ORDER if self.axis_size(ax) > 1)
+
+    def axis_size(self, axis: str) -> int:
+        return getattr(self, f"{axis}_size")
+
+    # ------------------------------------------------------------------
+    # Flattened logical axis groups — PartitionSpec-ready tuples.
+    # (reference flattens real submeshes, parallelism_config.py:211-272;
+    #  in JAX a tuple of axis names is equivalent and free.)
+    # ------------------------------------------------------------------
+
+    @property
+    def dp_axes(self) -> tuple[str, ...]:
+        return ("dp_replicate", "dp_shard")
+
+    @property
+    def fsdp_axes(self) -> tuple[str, ...]:
+        """Axes FSDP-style param sharding spans: dp_shard joined with cp
+        (reference: parallelism_config.py:157-164 ``fsdp_dim_names``)."""
+        return ("dp_shard", "cp")
+
+    @property
+    def batch_axes(self) -> tuple[str, ...]:
+        """Axes the global batch dim is sharded over. TP ranks see identical
+        batches (reference: data_loader.py:1127-1163); cp/sp ranks share a batch
+        but split the sequence dim."""
+        return ("dp_replicate", "dp_shard")
+
+    @property
+    def seq_axes(self) -> tuple[str, ...]:
+        """Axes the sequence dim is sharded over (cp or sp, never both)."""
+        return ("cp", "sp")
+
+    @property
+    def loss_reduce_axes(self) -> tuple[str, ...]:
+        """Axes a scalar loss must be averaged over — dp + cp + sp
+        (reference: SP loss averaged across sp+dp ranks, SURVEY.md §2.3)."""
+        return ("dp_replicate", "dp_shard", "cp", "sp")
+
+    # ------------------------------------------------------------------
+    # Env round-trip (reference: parallelism_config.py:274-289)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_env(cls) -> "ParallelismConfig":
+        p = PARALLELISM_CONFIG_PREFIX
+        return cls(
+            dp_replicate_size=get_int_from_env([f"{p}DP_REPLICATE_SIZE"], 1),
+            dp_shard_size=get_int_from_env([f"{p}DP_SHARD_SIZE"], 1),
+            cp_size=get_int_from_env([f"{p}CP_SIZE"], 1),
+            sp_size=get_int_from_env([f"{p}SP_SIZE"], 1),
+            tp_size=get_int_from_env([f"{p}TP_SIZE"], 1),
+            ep_size=get_int_from_env([f"{p}EP_SIZE"], 1),
+            pp_size=get_int_from_env([f"{p}PP_SIZE"], 1),
+            cp_rotate_method=parse_choice_from_env(f"{p}CP_ROTATE_METHOD", "alltoall"),
+        )
+
+    def to_env(self) -> dict[str, str]:
+        p = PARALLELISM_CONFIG_PREFIX
+        env = {
+            f"{p}DP_REPLICATE_SIZE": str(self.dp_replicate_size),
+            f"{p}DP_SHARD_SIZE": str(self.dp_shard_size),
+            f"{p}CP_SIZE": str(self.cp_size),
+            f"{p}SP_SIZE": str(self.sp_size),
+            f"{p}TP_SIZE": str(self.tp_size),
+            f"{p}EP_SIZE": str(self.ep_size),
+            f"{p}PP_SIZE": str(self.pp_size),
+            f"{p}CP_ROTATE_METHOD": self.cp_rotate_method,
+        }
+        return env
+
+    # ------------------------------------------------------------------
+    # Mesh construction
+    # ------------------------------------------------------------------
+
+    def infer_missing_axis(self, n_devices: int) -> "ParallelismConfig":
+        """Fill ``dp_shard_size`` so the mesh covers all devices when the user
+        left it at 1 and the product doesn't match (mirrors the reference's
+        auto world-size fill)."""
+        fixed = self.total_size
+        if fixed == n_devices:
+            return self
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"parallelism product {fixed} does not divide device count {n_devices}"
+            )
+        return dataclasses.replace(self, dp_shard_size=self.dp_shard_size * (n_devices // fixed))
+
+    def build_mesh(self, devices=None):
+        """Build the canonical :class:`jax.sharding.Mesh`.
+
+        Axes are always present (size-1 axes are free in GSPMD) so every
+        PartitionSpec in the framework can name any canonical axis without
+        branching on the active topology. Device order goes through
+        ``mesh_utils.create_device_mesh`` on real TPU slices so the innermost
+        axes (tp, sp, cp) land on ICI-adjacent chips; on CPU/virtual devices it
+        falls back to a plain reshape.
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        cfg = self.infer_missing_axis(n)
+        # ``pp`` is a real (leading) mesh axis so stage sub-meshes are
+        # contiguous device slices; the canonical GSPMD axes follow in the
+        # reference's order. PartitionSpecs never name ``pp`` — pipeline
+        # stages address their sub-mesh through parallel/pp.
+        axis_names = ("pp",) + MESH_AXIS_ORDER
+        shape = (cfg.pp_size,) + tuple(cfg.axis_size(ax) for ax in MESH_AXIS_ORDER)
+        platform = getattr(devices[0], "platform", "cpu")
+        if platform in ("tpu", "axon") and n > 1:
+            try:
+                from jax.experimental import mesh_utils
+
+                dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            except Exception:
+                dev_array = np.asarray(devices).reshape(shape)
+        else:
+            dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, axis_names)
+
+    def get_device_mesh(self, devices=None):
+        return self.build_mesh(devices)
+
+    def __repr__(self) -> str:  # compact, hides size-1 axes
+        active = {ax: self.axis_size(ax) for ax in MESH_AXIS_ORDER if self.axis_size(ax) > 1}
+        if self.ep_size > 1:
+            active["ep"] = self.ep_size
+        if self.pp_size > 1:
+            active["pp"] = self.pp_size
+        return f"ParallelismConfig({active or 'single-device'})"
+
+
+def build_mesh_from_env(devices=None):
+    """Convenience: decode ``PARALLELISM_CONFIG_*`` env and build the mesh."""
+    return ParallelismConfig.from_env().build_mesh(devices)

@@ -1,0 +1,68 @@
+"""Learning-rate scheduler wrapper (layer L4).
+
+Reference: src/accelerate/scheduler.py:25-98 — steps only when the optimizer
+actually stepped, and steps ``num_processes``× when batch-size scaling is off.
+The wrapped object is any callable ``schedule(count) -> lr`` (every optax
+schedule qualifies). When the optax chain itself embeds the schedule, lr
+consistency is automatic (opt_state count only advances on real steps); this
+wrapper keeps an explicit count for introspection, trackers and checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler: Callable,
+        optimizers=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+        self._step_count = 0
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self._step_count += 1
+            return
+        if not self.gradient_state.sync_gradients:
+            if self.gradient_state.adjust_scheduler:
+                # honor torch-style schedulers that track internal dataloader
+                # position; optax schedules are pure so nothing to do.
+                pass
+            return
+        # Skip when the optimizer step overflowed (fp16), mirroring
+        # reference: scheduler.py:69-82.
+        for opt in self.optimizers:
+            if opt is not None and getattr(opt, "step_was_skipped", False):
+                return
+        if self.split_batches:
+            self._step_count += 1
+        else:
+            num_processes = AcceleratorState().num_processes
+            for _ in range(num_processes):
+                self._step_count += 1
+
+    def get_last_lr(self):
+        try:
+            return float(self.scheduler(self._step_count))
+        except TypeError:
+            return None
+
+    def state_dict(self):
+        return {"step_count": self._step_count}
+
+    def load_state_dict(self, state_dict):
+        self._step_count = int(state_dict["step_count"])
+
+    def get_lr(self):
+        return self.get_last_lr()

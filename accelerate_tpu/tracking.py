@@ -1,0 +1,322 @@
+"""Experiment tracking (layer L9).
+
+Reference: src/accelerate/tracking.py (1315 LoC, 9 integrations). Trackers are
+pure-Python and port structurally: an abstract :class:`GeneralTracker`, a
+registry keyed by name, availability-probed integrations, and main-process
+gating. A dependency-free :class:`JSONTracker` is always available (the role
+the reference fills with tensorboard-by-default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import wraps
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_swanlab_available,
+    is_tensorboard_available,
+    is_trackio_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Run a tracker method only on the main process
+    (reference: tracking.py:77-99)."""
+
+    @wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Abstract tracker (reference: tracking.py:101-176). Subclasses set
+    ``name``, ``requires_logging_directory`` and implement ``tracker``,
+    ``store_init_configuration`` and ``log``."""
+
+    main_process_only = True
+    name: str = "general"
+    requires_logging_directory: bool = False
+
+    def __init__(self, _blank: bool = False):
+        self._started = not _blank
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def start(self):
+        pass
+
+    def store_init_configuration(self, values: dict):
+        raise NotImplementedError
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        raise NotImplementedError
+
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONTracker(GeneralTracker):
+    """Dependency-free tracker: one JSONL file of metric records. Always
+    available, making `init_trackers`/`log` functional on a bare TPU VM."""
+
+    name = "json"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, f"{run_name}.metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self._write({"event": "config", "values": _jsonable(values)})
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self._write({"event": "log", "step": step, "time": time.time(), "values": _jsonable(values)})
+
+    def _write(self, record: dict):
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self):
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """(reference: tracking.py:178-292)"""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(_flatten_for_hparams(values), metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "item"):
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, {kk: float(vv) for kk, vv in v.items()}, global_step=step)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """(reference: tracking.py:293-417)"""
+
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """(reference: tracking.py:692-900)"""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self.active_run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for name, value in values.items():
+            mlflow.log_param(name, value)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        metrics = {k: float(v) for k, v in values.items() if isinstance(v, (int, float)) or hasattr(v, "item")}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "json": JSONTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+}
+
+_AVAILABILITY = {
+    "json": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+    "swanlab": is_swanlab_available,
+    "trackio": is_trackio_available,
+}
+
+
+def get_available_trackers() -> list[str]:
+    return [name for name, probe in _AVAILABILITY.items() if name in LOGGER_TYPE_TO_CLASS and probe()]
+
+
+def filter_trackers(log_with, logging_dir: Optional[str] = None) -> list:
+    """Resolve the user's ``log_with`` request against available integrations
+    (reference: tracking.py:1260-1315). ``"all"`` selects everything
+    available; unknown/unavailable names warn and drop."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    loggers = []
+    if "all" in [str(l) for l in log_with]:
+        return get_available_trackers()
+    for log_type in log_with:
+        if isinstance(log_type, GeneralTracker):
+            loggers.append(log_type)
+            continue
+        name = str(log_type)
+        if name not in LOGGER_TYPE_TO_CLASS:
+            logger.warning(f"Tried adding logger {name}, but no tracker with that name exists here.")
+            continue
+        if not _AVAILABILITY[name]():
+            logger.warning(f"Tried adding logger {name}, but that package is not installed.")
+            continue
+        if LOGGER_TYPE_TO_CLASS[name].requires_logging_directory and logging_dir is None:
+            raise ValueError(f"Logging with `{name}` requires a `logging_dir` to be passed in.")
+        loggers.append(name)
+    return loggers
+
+
+def resolve_trackers(log_with: list, project_name: str, logging_dir: Optional[str], init_kwargs: dict) -> list:
+    trackers = []
+    for entry in log_with or []:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[entry]
+        kwargs = init_kwargs.get(entry, {})
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir or ".", **kwargs))
+        else:
+            trackers.append(cls(project_name, **kwargs))
+    return trackers
+
+
+def _jsonable(values):
+    def conv(v):
+        if hasattr(v, "item"):
+            try:
+                return v.item()
+            except Exception:
+                return str(v)
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return v
+        return str(v)
+
+    return conv(values)
+
+
+def _flatten_for_hparams(values: dict) -> dict:
+    out = {}
+    for k, v in values.items():
+        if isinstance(v, (int, float, str, bool)):
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out

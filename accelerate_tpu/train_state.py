@@ -1,0 +1,100 @@
+"""The canonical training-state pytree.
+
+The reference mutates four user objects in place (model, optimizer, scheduler,
+dataloader — reference: accelerator.py:1414). The TPU-native equivalent is one
+immutable pytree that flows through a jitted step: params (fp32 masters),
+optimizer state, step counter, accumulated grads (for the imperative API) and
+an optional dynamic loss scale (fp16). Sharding of every leaf is planned once
+in ``Accelerator.prepare`` and enforced via jit in/out shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class DynamicLossScale:
+    """fp16 dynamic loss scaling in pure JAX (the reference delegates to
+    torch.amp.GradScaler, accelerator.py:577-583). bf16 never needs this."""
+
+    scale: jax.Array
+    growth_tracker: jax.Array
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**16, **kwargs) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+            **kwargs,
+        )
+
+    def unscale(self, grads):
+        inv = 1.0 / self.scale
+        return jax.tree.map(lambda g: (g * inv).astype(g.dtype), grads)
+
+    def update(self, grads_finite: jax.Array) -> "DynamicLossScale":
+        tracker = jnp.where(grads_finite, self.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.scale * self.growth_factor, self.scale),
+            jnp.maximum(self.scale * self.backoff_factor, 1.0),
+        )
+        return self.replace(scale=new_scale, growth_tracker=jnp.where(grow, 0, tracker))
+
+
+def grads_all_finite(grads) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
+@struct.dataclass
+class TrainState:
+    """Step counter + params + optax optimizer state (+ mutable collections
+    like batch_stats for models that carry them)."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    extra_state: Any = None           # e.g. flax batch_stats / cache collections
+    accum_grads: Any = None           # imperative grad-accum buffer
+    loss_scale: Optional[DynamicLossScale] = None
+    apply_fn: Callable = struct.field(pytree_node=False, default=None)
+    tx: Any = struct.field(pytree_node=False, default=None)
+
+    @classmethod
+    def create(cls, *, apply_fn=None, params, tx, extra_state=None, loss_scale=None) -> "TrainState":
+        opt_state = tx.init(params) if tx is not None else ()
+        return cls(
+            step=jnp.asarray(0, jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            extra_state=extra_state,
+            accum_grads=None,
+            loss_scale=loss_scale,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads, **kwargs) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        import optax
+
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state, **kwargs
+        )
+
+    def with_zero_accum(self) -> "TrainState":
+        zeros = jax.tree.map(lambda p: jnp.zeros_like(p), self.params)
+        return self.replace(accum_grads=zeros)

@@ -1,0 +1,14 @@
+"""Default config file locations (reference: commands/config/config_args.py)."""
+
+import os
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "ACCELERATE_CONFIG_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu"),
+    )
+
+
+def default_config_file() -> str:
+    return os.path.join(cache_dir(), "default_config.json")

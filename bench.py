@@ -1,0 +1,112 @@
+"""Benchmark: Llama training throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures tokens/sec/chip for an FSDP-prepared Llama decoder train step in bf16
+(the BASELINE.json headline: FSDP2 Llama tokens/sec/chip, target ≥45% MFU).
+``vs_baseline`` reports achieved_MFU / 0.45 — ≥1.0 means the MFU target is met.
+Model size auto-scales down when HBM is small (CPU fallback uses the tiny
+config so the script always completes).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _pick_config(platform: str):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig
+
+    if platform in ("tpu", "axon"):
+        # ~410M params: fits one v5e chip (16GB HBM) with Adam fp32 states.
+        return (
+            LlamaConfig(
+                vocab_size=32000,
+                hidden_size=1024,
+                intermediate_size=4096,
+                num_hidden_layers=16,
+                num_attention_heads=8,
+                num_key_value_heads=8,
+                max_position_embeddings=2048,
+                dtype=jnp.bfloat16,
+                remat=True,
+            ),
+            8,     # batch
+            2048,  # seq
+        )
+    return LlamaConfig.tiny(dtype=jnp.bfloat16), 4, 128
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+    set_seed(0)
+    cfg, batch, seq = _pick_config(platform)
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1), dtype=np.int32)
+
+    acc = Accelerator(mixed_precision="bf16", fsdp_plugin=FullyShardedDataParallelPlugin())
+    model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+    model, _ = acc.prepare(model, optax.adamw(3e-4, weight_decay=0.1))
+    n_params = model.num_parameters()
+
+    def loss_fn(params, b):
+        logits = module.apply({"params": params}, b["x"])
+        return cross_entropy_loss(logits, b["y"])
+
+    step = acc.prepare_train_step(loss_fn, max_grad_norm=1.0)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(acc.mesh, PartitionSpec(("dp_replicate", "dp_shard")))
+    b = {
+        "x": jax.device_put(ids[:, :-1], sharding),
+        "y": jax.device_put(ids[:, 1:], sharding),
+    }
+
+    state = acc.train_state
+    # Warmup/compile.
+    state, metrics = step(state, b)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20 if platform in ("tpu", "axon") else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, b)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    n_devices = len(jax.devices())
+    tokens_per_step = batch * seq
+    tok_s_chip = tokens_per_step / dt / n_devices
+
+    # MFU: ~6*N FLOPs/token for fwd+bwd + attention term 12*L*H*S per token.
+    attn_flops_per_token = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops_per_token
+    peak_flops = {"tpu": 197e12, "axon": 197e12}.get(platform, 1e12)  # v5e bf16
+    mfu = tok_s_chip * flops_per_token / peak_flops
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_fsdp_train_tokens_per_sec_per_chip",
+                "value": round(tok_s_chip, 1),
+                "unit": f"tokens/s/chip (bf16, {n_params/1e6:.0f}M params, seq {seq}, MFU {mfu:.3f})",
+                "vs_baseline": round(mfu / 0.45, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

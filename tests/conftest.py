@@ -1,0 +1,34 @@
+"""Test fixtures: virtual 8-device CPU mesh + singleton reset.
+
+Mirrors the reference's test strategy (SURVEY.md §4): a CPU multi-device
+fake-mesh path for CI (`xla_force_host_platform_device_count`) and
+singleton-reset fixtures (the reference's `AccelerateTestCase`,
+test_utils/testing.py:667-679).
+"""
+
+import os
+
+# Must run before jax initializes its backend (jax may already be *imported*
+# by a sitecustomize hook, so set the config knob too, not just the env).
+# Tests always target the virtual CPU mesh (set ACCELERATE_TEST_USE_TPU=1 to
+# run against real chips).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("ACCELERATE_TEST_USE_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_accelerate_state():
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

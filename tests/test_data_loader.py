@@ -1,0 +1,157 @@
+import numpy as np
+import pytest
+
+
+def _indices(it):
+    return list(it)
+
+
+def test_batch_sampler_shard_round_robin():
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard, SequentialSampler
+
+    inner = BatchSampler(SequentialSampler(24), batch_size=4, drop_last=False)
+    shards = [
+        BatchSamplerShard(inner, num_processes=2, process_index=i, even_batches=True)
+        for i in range(2)
+    ]
+    b0, b1 = _indices(shards[0]), _indices(shards[1])
+    assert len(b0) == len(b1) == 3
+    # Round-robin: rank0 gets batches 0,2,4; rank1 gets 1,3,5.
+    assert b0[0] == [0, 1, 2, 3]
+    assert b1[0] == [4, 5, 6, 7]
+    # Together they cover everything exactly once.
+    flat = sorted(i for b in b0 + b1 for i in b)
+    assert flat == list(range(24))
+
+
+def test_batch_sampler_shard_uneven_even_batches():
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard, SequentialSampler
+
+    # 21 samples, batch 4 → 6 batches, last has 1 sample.
+    inner = BatchSampler(SequentialSampler(21), batch_size=4, drop_last=False)
+    shards = [
+        BatchSamplerShard(inner, num_processes=2, process_index=i, even_batches=True)
+        for i in range(2)
+    ]
+    b0, b1 = _indices(shards[0]), _indices(shards[1])
+    assert len(b0) == len(b1)
+    for b in b0 + b1:
+        assert len(b) == 4
+
+
+def test_batch_sampler_shard_split_batches():
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard, SequentialSampler
+
+    inner = BatchSampler(SequentialSampler(16), batch_size=8, drop_last=False)
+    shards = [
+        BatchSamplerShard(inner, num_processes=2, process_index=i, split_batches=True)
+        for i in range(2)
+    ]
+    b0, b1 = _indices(shards[0]), _indices(shards[1])
+    assert b0[0] == [0, 1, 2, 3]
+    assert b1[0] == [4, 5, 6, 7]
+    assert len(b0) == len(b1) == 2
+
+
+def test_iterable_dataset_shard():
+    from accelerate_tpu.data_loader import IterableDatasetShard
+
+    data = list(range(22))
+    shards = [
+        IterableDatasetShard(data, batch_size=4, num_processes=2, process_index=i)
+        for i in range(2)
+    ]
+    s0, s1 = list(shards[0]), list(shards[1])
+    assert len(s0) == len(s1)
+    # First window: rank0 gets 0-3, rank1 gets 4-7.
+    assert s0[:4] == [0, 1, 2, 3]
+    assert s1[:4] == [4, 5, 6, 7]
+
+
+def test_seedable_random_sampler_resumable():
+    from accelerate_tpu.data_loader import SeedableRandomSampler
+
+    s = SeedableRandomSampler(10, seed=5)
+    first = list(s)
+    s2 = SeedableRandomSampler(10, seed=5)
+    assert list(s2) == first
+    second = list(s)  # epoch advanced
+    assert second != first
+    assert sorted(second) == list(range(10))
+
+
+class _ToyDataset:
+    def __init__(self, n=32, dim=4):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, dim)).astype(np.float32)
+        self.y = (self.x.sum(-1) > 0).astype(np.int32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+class _LoaderSpec:
+    """Minimal duck-typed 'dataloader' (dataset + batch_size)."""
+
+    def __init__(self, dataset, batch_size, shuffle=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = None
+        self.drop_last = False
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+def test_prepare_data_loader_shards_batches():
+    import jax
+
+    from accelerate_tpu import AcceleratorState, prepare_data_loader
+
+    AcceleratorState()  # builds default 8-device dp mesh
+    ds = _ToyDataset(n=32)
+    dl = prepare_data_loader(_LoaderSpec(ds, batch_size=16))
+    batches = list(dl)
+    assert len(batches) == 2
+    batch = batches[0]
+    assert isinstance(batch["x"], jax.Array)
+    assert batch["x"].shape == (16, 4)
+    # Batch dim sharded over the 8 dp devices.
+    assert len(batch["x"].sharding.device_set) == 8
+
+
+def test_end_of_dataloader_flag():
+    from accelerate_tpu import AcceleratorState, GradientState, prepare_data_loader
+
+    AcceleratorState()
+    ds = _ToyDataset(n=32)
+    dl = prepare_data_loader(_LoaderSpec(ds, batch_size=8), put_on_device=False)
+    flags = []
+    for _ in dl:
+        flags.append(dl.end_of_dataloader)
+    assert flags == [False, False, False, True]
+
+
+def test_skip_first_batches():
+    from accelerate_tpu import AcceleratorState, prepare_data_loader, skip_first_batches
+
+    AcceleratorState()
+    ds = _ToyDataset(n=32)
+    dl = prepare_data_loader(_LoaderSpec(ds, batch_size=8), put_on_device=False)
+    skipped = skip_first_batches(dl, 2)
+    assert len(list(skipped)) == 2
+
+
+def test_dispatcher_single_process():
+    from accelerate_tpu import AcceleratorState
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    AcceleratorState()
+    ds = _ToyDataset(n=16)
+    dl = prepare_data_loader(_LoaderSpec(ds, batch_size=8), dispatch_batches=True, put_on_device=False)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (8, 4)

@@ -1,0 +1,116 @@
+"""Flagship-model tests: forward correctness, TP/FSDP sharded training on the
+8-device CPU mesh, scan vs unrolled equivalence."""
+
+import numpy as np
+import pytest
+
+
+def _data(bs=8, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(bs, seq + 1), dtype=np.int32)
+    return ids[:, :-1], ids[:, 1:]
+
+
+def test_scan_matches_unrolled():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    x, _ = _data(bs=2, seq=16)
+    cfg_s = LlamaConfig.tiny(scan_layers=True, dtype=jnp.float32)
+    cfg_u = LlamaConfig.tiny(scan_layers=False, dtype=jnp.float32)
+    m_s = LlamaForCausalLM(cfg_s)
+    m_u = LlamaForCausalLM(cfg_u)
+    p_s = m_s.init(jax.random.key(0), x)["params"]
+    p_u = m_u.init(jax.random.key(0), x)["params"]
+
+    # Copy scanned params (leading layer dim) into the unrolled structure.
+    def unroll(tree):
+        import jax
+
+        return tree
+
+    blk = p_s["model"]["layers"]["block"]
+    for i in range(cfg_u.num_hidden_layers):
+        tgt = p_u["model"][f"layers_{i}"]
+        src = jax.tree.map(lambda a: a[i], blk)
+        p_u["model"][f"layers_{i}"] = src
+    p_u["model"]["embed_tokens"] = p_s["model"]["embed_tokens"]
+    p_u["model"]["norm"] = p_s["model"]["norm"]
+    p_u["lm_head"] = p_s["lm_head"]
+
+    out_s = m_s.apply({"params": p_s}, x)
+    out_u = m_u.apply({"params": p_u}, x)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("topology", ["fsdp", "tp", "fsdp_tp"])
+def test_llama_sharded_training_step(topology):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, cross_entropy_loss, llama_tp_rules
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    pc = {
+        "fsdp": ParallelismConfig(dp_shard_size=8),
+        "tp": ParallelismConfig(tp_size=8),
+        "fsdp_tp": ParallelismConfig(dp_shard_size=4, tp_size=2),
+    }[topology]
+    acc = Accelerator(
+        parallelism_config=pc,
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=0)
+        if "fsdp" in topology
+        else None,
+    )
+    module = LlamaForCausalLM(cfg)
+    x, y = _data(bs=8, seq=32, vocab=cfg.vocab_size)
+    model = Model.from_flax(
+        module, jax.random.key(0), x, tp_rules=llama_tp_rules(cfg.scan_layers) if "tp" in topology else None
+    )
+    model, opt = acc.prepare(model, optax.adamw(1e-3))
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    step = acc.prepare_train_step(loss_fn, max_grad_norm=1.0)
+    state = acc.train_state
+    batch = {
+        "x": jax.device_put(
+            x, jax.sharding.NamedSharding(acc.mesh, jax.sharding.PartitionSpec(pc.batch_axes))
+        ),
+        "y": jax.device_put(
+            y, jax.sharding.NamedSharding(acc.mesh, jax.sharding.PartitionSpec(pc.batch_axes))
+        ),
+    }
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_tp_params_actually_sharded():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tp_rules
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    acc = Accelerator(parallelism_config=ParallelismConfig(tp_size=8))
+    module = LlamaForCausalLM(cfg)
+    x, _ = _data(bs=8, seq=16, vocab=cfg.vocab_size)
+    model = Model.from_flax(module, jax.random.key(0), x, tp_rules=llama_tp_rules(True))
+    model, _ = acc.prepare(model, optax.sgd(0.1))
+    gate = acc.train_state.params["model"]["layers"]["block"]["mlp"]["gate_proj"]["kernel"]
+    spec = gate.sharding.spec
+    assert "tp" in str(spec)

@@ -1,0 +1,113 @@
+import numpy as np
+import pytest
+
+
+def test_recursively_apply_nested():
+    from accelerate_tpu.utils import recursively_apply
+
+    data = {"a": np.ones((2, 2)), "b": [np.zeros(3), (np.ones(1),)]}
+    out = recursively_apply(lambda t: t + 1, data)
+    assert np.allclose(out["a"], 2)
+    assert np.allclose(out["b"][1][0], 2)
+
+
+def test_honor_type_namedtuple():
+    import collections
+
+    from accelerate_tpu.utils import recursively_apply
+
+    Point = collections.namedtuple("Point", ["x", "y"])
+    p = Point(np.ones(2), np.zeros(2))
+    out = recursively_apply(lambda t: t * 3, p)
+    assert isinstance(out, Point)
+    assert np.allclose(out.x, 3)
+
+
+def test_gather_single_process():
+    from accelerate_tpu.utils import gather
+    import jax.numpy as jnp
+
+    out = gather({"x": jnp.arange(4)})
+    assert np.allclose(out["x"], np.arange(4))
+
+
+def test_gather_object_single():
+    from accelerate_tpu.utils import gather_object
+
+    assert gather_object({"k": 1}) == [{"k": 1}]
+
+
+def test_pad_across_processes():
+    from accelerate_tpu.utils import pad_across_processes
+    import jax.numpy as jnp
+
+    t = jnp.ones((2, 3))
+    out = pad_across_processes(t, dim=1)
+    assert out.shape == (2, 3)  # single process: no growth
+
+
+def test_find_batch_size_and_slice():
+    from accelerate_tpu.utils import find_batch_size, slice_tensors
+
+    batch = {"input_ids": np.ones((8, 16)), "labels": np.ones(8)}
+    assert find_batch_size(batch) == 8
+    sliced = slice_tensors(batch, 2, 5)
+    assert sliced["input_ids"].shape == (3, 16)
+
+
+def test_concatenate():
+    from accelerate_tpu.utils import concatenate
+
+    batches = [{"x": np.ones((2, 4))}, {"x": np.zeros((3, 4))}]
+    out = concatenate(batches)
+    assert out["x"].shape == (5, 4)
+
+
+def test_get_data_structure_initialize():
+    from accelerate_tpu.utils import get_data_structure, initialize_tensors
+
+    data = {"a": np.ones((2, 3), dtype=np.float32)}
+    info = get_data_structure(data)
+    out = initialize_tensors(info)
+    assert out["a"].shape == (2, 3)
+
+
+def test_flatten_unflatten_state_dict():
+    from accelerate_tpu.utils import flatten_state_dict, unflatten_state_dict
+
+    tree = {"layer": {"kernel": np.ones((2, 2)), "bias": np.zeros(2)}, "scale": np.ones(1)}
+    flat = flatten_state_dict(tree)
+    assert set(flat) == {"layer/kernel", "layer/bias", "scale"}
+    rt = unflatten_state_dict(flat)
+    assert np.allclose(rt["layer"]["kernel"], tree["layer"]["kernel"])
+
+
+def test_shard_state_dict_index():
+    from accelerate_tpu.utils import shard_state_dict
+
+    sd = {f"w{i}": np.ones(100, dtype=np.float32) for i in range(10)}
+    named, index = shard_state_dict(sd, max_shard_size=500)
+    assert index is not None
+    assert sum(len(s) for s in named.values()) == 10
+
+
+def test_set_seed_deterministic():
+    from accelerate_tpu.utils import next_rng_key, set_seed
+
+    import jax
+
+    set_seed(42)
+    k1 = jax.random.key_data(next_rng_key("dropout"))
+    set_seed(42)
+    k2 = jax.random.key_data(next_rng_key("dropout"))
+    assert np.array_equal(k1, k2)
+    k3 = jax.random.key_data(next_rng_key("dropout"))
+    assert not np.array_equal(k2, k3)
+
+
+def test_convert_bytes_parse_bytes():
+    from accelerate_tpu.utils import convert_bytes, parse_bytes
+
+    assert parse_bytes("5GB") == 5 * 10**9
+    assert parse_bytes("1KiB") == 1024
+    assert "KB" in convert_bytes(2048)

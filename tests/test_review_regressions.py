@@ -1,0 +1,176 @@
+"""Regressions for code-review findings."""
+
+import numpy as np
+import pytest
+
+
+def test_batch_sampler_tail_distinct_chunks():
+    """10 samples, batch 2, 4 procs: final round fillers must yield DISTINCT
+    chunks, not P copies of initial_data[:2]."""
+    from accelerate_tpu.data_loader import BatchSampler, BatchSamplerShard, SequentialSampler
+
+    inner = BatchSampler(SequentialSampler(10), batch_size=2, drop_last=False)
+    shards = [
+        BatchSamplerShard(inner, num_processes=4, process_index=i, even_batches=True)
+        for i in range(4)
+    ]
+    rows = [list(s) for s in shards]
+    lengths = {len(r) for r in rows}
+    assert lengths == {2}
+    final_round = [r[-1] for r in rows]
+    # proc0 got the real batch [8,9]; fillers must be pairwise distinct.
+    assert final_round[0] == [8, 9]
+    filled = [tuple(b) for b in final_round[1:]]
+    assert len(set(filled)) == len(filled), f"duplicate filler chunks: {final_round}"
+
+
+def test_rng_stream_hash_deterministic():
+    import subprocess
+    import sys
+
+    code = (
+        "from accelerate_tpu.utils.random import set_seed, next_rng_key\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        "set_seed(7)\n"
+        "print(jax.random.key_data(next_rng_key('dropout')).tolist())\n"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            env={"PYTHONHASHSEED": str(i), "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": "/root/repo"},
+        ).stdout.strip()
+        for i in (1, 2)
+    }
+    assert len(outs) == 1, f"stream key differs across hash seeds: {outs}"
+
+
+def test_pp_mesh_builds():
+    from accelerate_tpu import ParallelismConfig
+
+    cfg = ParallelismConfig(tp_size=4, pp_size=2)
+    mesh = cfg.build_mesh()
+    assert mesh.shape["pp"] == 2
+    assert mesh.shape["tp"] == 4
+
+
+def test_dispatcher_partial_final_batch():
+    from accelerate_tpu import AcceleratorState
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    AcceleratorState()
+
+    class DS:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return {"x": np.float32([i])}
+
+    class Spec:
+        dataset = DS()
+        batch_size = 4
+        sampler = None
+        drop_last = False
+
+    dl = prepare_data_loader(Spec(), dispatch_batches=True, put_on_device=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[-1]["x"].shape[0] == 2  # single process: partial tail kept
+
+
+def test_reduce_global_array_identity_scale():
+    import jax.numpy as jnp
+
+    from accelerate_tpu import AcceleratorState
+    from accelerate_tpu.utils import reduce
+
+    AcceleratorState()
+    out = reduce(jnp.asarray(3.0), reduction="sum", scale=2.0)
+    assert float(out) == 6.0
+
+
+def test_llama_ring_attention_training():
+    """cp=4 mesh, attention_impl='ring': one fused train step on the tiny
+    llama with the sequence sharded — loss finite and decreasing."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(0)
+    pc = ParallelismConfig(dp_shard_size=2, cp_size=4)
+    acc = Accelerator(parallelism_config=pc)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="ring")
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(4, 65), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+    model, _ = acc.prepare(model, optax.adam(1e-3))
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["x"])
+        return cross_entropy_loss(logits, batch["y"])
+
+    step = acc.prepare_train_step(loss_fn)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(acc.mesh, P(pc.batch_axes, ("cp",)))
+    batch = {
+        "x": jax.device_put(ids[:, :-1], sharding),
+        "y": jax.device_put(ids[:, 1:], sharding),
+    }
+    state = acc.train_state
+    losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_llama_ring_matches_native_loss():
+    """Ring attention loss must equal native attention loss on the same data."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(8, 65), dtype=np.int32)
+
+    def run(impl, pc):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(0)
+        acc = Accelerator(parallelism_config=pc)
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl=impl)
+        module = LlamaForCausalLM(cfg)
+        model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+        model, _ = acc.prepare(model, optax.sgd(1e-2))
+
+        def loss_fn(params, batch):
+            logits = module.apply({"params": params}, batch["x"])
+            return cross_entropy_loss(logits, batch["y"])
+
+        step = acc.prepare_train_step(loss_fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(acc.mesh, P(pc.batch_axes))
+        batch = {"x": jax.device_put(ids[:, :-1], sharding), "y": jax.device_put(ids[:, 1:], sharding)}
+        _, m = step(acc.train_state, batch)
+        return float(m["loss"])
+
+    l_native = run("native", ParallelismConfig(dp_shard_size=8))
+    l_ring = run("ring", ParallelismConfig(dp_shard_size=2, cp_size=4))
+    np.testing.assert_allclose(l_native, l_ring, rtol=1e-5)

@@ -1,0 +1,81 @@
+import numpy as np
+import pytest
+
+
+def test_partial_state_singleton():
+    from accelerate_tpu import PartialState
+
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_processes == 1
+    assert a.is_main_process
+    assert a.num_devices == 8
+
+
+def test_split_between_processes_single():
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as vals:
+        assert vals == [1, 2, 3]
+
+
+def test_parallelism_config_mesh():
+    from accelerate_tpu import ParallelismConfig
+
+    cfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    mesh = cfg.build_mesh()
+    assert mesh.shape["dp_shard"] == 4
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp_replicate"] == 1
+    assert cfg.total_size == 8
+
+
+def test_parallelism_config_infer():
+    from accelerate_tpu import ParallelismConfig
+
+    cfg = ParallelismConfig(tp_size=2)
+    mesh = cfg.build_mesh()
+    assert mesh.shape["dp_shard"] == 4  # auto-filled to cover 8 devices
+
+
+def test_parallelism_config_validation():
+    from accelerate_tpu import ParallelismConfig
+
+    with pytest.raises(ValueError):
+        ParallelismConfig(cp_size=2, sp_size=2)
+    with pytest.raises(ValueError):
+        ParallelismConfig(dp_shard_size=0)
+
+
+def test_parallelism_config_env_roundtrip(monkeypatch):
+    from accelerate_tpu import ParallelismConfig
+
+    cfg = ParallelismConfig(dp_shard_size=2, tp_size=4, cp_rotate_method="allgather")
+    for k, v in cfg.to_env().items():
+        monkeypatch.setenv(k, v)
+    decoded = ParallelismConfig.from_env()
+    assert decoded == cfg
+
+
+def test_accelerator_state_mesh_default():
+    from accelerate_tpu import AcceleratorState
+
+    state = AcceleratorState()
+    mesh = state.mesh
+    assert mesh.devices.size == 8
+    # Default: everything lands on dp_shard (FSDP-ready pure-DP mesh).
+    assert mesh.shape["dp_shard"] == 8
+
+
+def test_gradient_state_accumulation_flags():
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(gradient_accumulation_steps=2)
+    assert acc.gradient_accumulation_steps == 2
+    with acc.accumulate():
+        first = acc.sync_gradients
+    with acc.accumulate():
+        second = acc.sync_gradients
+    assert (first, second) == (False, True)
