@@ -56,11 +56,13 @@ class LlamaConfig:
 
     @classmethod
     def tiny(cls, **kw):
-        return cls(
+        defaults = dict(
             vocab_size=256, hidden_size=128, intermediate_size=384,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-            max_position_embeddings=512, **kw,
+            max_position_embeddings=512,
         )
+        defaults.update(kw)
+        return cls(**defaults)
 
     @classmethod
     def llama_7b(cls, **kw):

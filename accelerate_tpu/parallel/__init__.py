@@ -5,3 +5,4 @@ from .sharding import (
     named_sharding,
     replicated,
 )
+from .pp import llama_pipeline_forward, pipeline_apply

@@ -1,0 +1,232 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` mesh axis.
+
+The reference reaches pipeline parallelism two ways: inference-only via
+``torch.distributed.pipelining`` (reference: inference.py:75-187 —
+``build_pipeline`` + ``ScheduleGPipe``) and training via the Megatron-LM
+engine (reference: utils/megatron_lm.py:926, ``get_forward_backward_func``).
+Both are imperative runtimes that move activations with NCCL P2P sends.
+
+The TPU-native design is a *compiled* pipeline: one ``jax.shard_map`` manual
+over the leading ``pp`` mesh axis (every other axis stays under GSPMD auto
+control, so FSDP/TP/DP sharding of the non-pipeline dims composes untouched),
+with the classic GPipe loop expressed as ``lax.scan`` over
+``n_microbatches + n_stages - 1`` ticks and activations passed stage→stage+1
+by ``lax.ppermute`` over ICI. Because ``scan``/``ppermute``/``where`` all have
+transpose rules, the SAME schedule is the backward pass — ``jax.grad``
+through ``pipeline_apply`` is 1F1B-shaped for free, no hand-written schedule
+runtime.
+
+Stage weights: a stack of L identical layers lives in one pytree whose leaves
+have leading dim L (the ``nn.scan`` layout); sharding that dim over ``pp``
+gives each stage its contiguous L/pp layers *locally* — ``shard_map`` with
+``in_specs=P("pp")`` hands each stage exactly its slice, no reshapes, no
+parameter movement.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Eager-call compile cache: (stage_fn, mesh, schedule, arg structure) → jitted
+# pipeline. Inside a jit trace the shard_map inlines and this is bypassed.
+_EAGER_CACHE: dict = {}
+
+
+def _active_mesh(mesh: Optional[Mesh]) -> Mesh:
+    if mesh is not None:
+        return mesh
+    from ..state import AcceleratorState, is_initialized
+
+    if is_initialized():
+        st = AcceleratorState()
+        if getattr(st, "mesh", None) is not None:
+            return st.mesh
+    raise ValueError("pipeline_apply needs a mesh (pass mesh= or build an Accelerator).")
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_microbatches: Optional[int] = None,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run ``x`` through a layer stack pipelined over the ``pp`` mesh axis.
+
+    Args:
+      stage_fn: ``(local_layer_stack, h) -> h`` — applies ONE stage's worth of
+        layers to a microbatch of hidden states. Inside, leaves of
+        ``local_layer_stack`` have leading dim ``L // pp``. Must preserve the
+        shape/dtype of ``h``.
+      stage_params: pytree of stacked layer weights; every leaf has leading
+        dim L (divisible by the ``pp`` axis size).
+      x: ``(B, ...)`` hidden states; ``B`` is split into microbatches.
+      n_microbatches: defaults to the ``pp`` degree (the minimum that keeps
+        every stage busy outside the fill/drain bubble).
+
+    Returns ``(B, ...)`` outputs, replicated over ``pp`` like the input.
+    """
+    mesh = _active_mesh(mesh)
+    n_stages = mesh.shape.get(axis_name, 1)
+    if n_stages == 1:
+        return stage_fn(stage_params, x)
+
+    n_micro = int(n_microbatches or n_stages)
+    batch = x.shape[0]
+    if batch % n_micro != 0:
+        raise ValueError(f"batch dim {batch} not divisible by n_microbatches {n_micro}")
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] % n_stages != 0:
+            raise ValueError(
+                f"layer-stack leading dim {leaf.shape[0]} not divisible by pp={n_stages}"
+            )
+    mb = batch // n_micro
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    compute_dtype = x.dtype
+
+    def body(local_params, x_full):
+        stage = jax.lax.axis_index(axis_name)
+        x_full = x_full.astype(compute_dtype)
+        mbs = x_full.reshape(n_micro, mb, *x_full.shape[1:])
+        ticks = n_micro + n_stages - 1
+
+        def loop(carry, t):
+            state, out_buf = carry
+            # Stage 0 pulls microbatch t (clamped during drain); later stages
+            # consume what the previous stage sent last tick.
+            mb_t = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            inp = jnp.where(stage == 0, mb_t, state)
+            out = stage_fn(local_params, inp)
+            # The last stage finishes microbatch (t - n_stages + 1) at tick t.
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            prev = jax.lax.dynamic_index_in_dim(out_buf, out_idx, 0, keepdims=False)
+            keep = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(keep, out, prev), out_idx, 0
+            )
+            nxt = jax.lax.ppermute(out, axis_name, fwd)
+            return (nxt, out_buf), None
+
+        init = (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
+        (_, out_buf), _ = jax.lax.scan(loop, init, jnp.arange(ticks))
+        return out_buf
+
+    # Each stage emits its (n_micro, mb, ...) buffer; stacking them over the
+    # ``pp`` out-spec keeps the real outputs resident on the last stage with
+    # NO collective at pipe exit — the slice below just addresses that block
+    # and GSPMD moves it lazily wherever the consumer needs it.
+    pipelined = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), stage_params), P()),
+        out_specs=P(axis_name),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    # The replicated-input spec P() makes autodiff insert a psum over ``pp``
+    # for the input cotangent; a bf16 psum inside partial-manual shard_map
+    # trips an XLA CPU-backend assertion, so the activation crosses the
+    # boundary in f32 (cast back to the compute dtype on entry — the
+    # converts fuse, and the bwd psum carries mostly zeros anyway since only
+    # stage 0 reads the input).
+    x_in = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    # Partial-manual shard_map only lowers under jit, and a fresh jax.jit per
+    # call would retrace on every eager call — cache by schedule + argument
+    # structure. Under an outer jit/grad trace the cached wrapper inlines.
+    key = (
+        stage_fn,
+        mesh,
+        axis_name,
+        n_micro,
+        jax.tree.structure(stage_params),
+        tuple((l.shape, jnp.result_type(l)) for l in jax.tree.leaves(stage_params)),
+        x_in.shape,
+        jnp.result_type(x_in),
+        jnp.result_type(x),  # compute dtype captured by the closure
+    )
+    jitted = _EAGER_CACHE.get(key)
+    if jitted is None:
+        jitted = _EAGER_CACHE[key] = jax.jit(pipelined)
+    stacked = jitted(stage_params, x_in)
+    last = stacked[(n_stages - 1) * n_micro :]
+    return last.reshape(batch, *x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Flagship-model convenience: pipelined Llama forward. The embedding / final
+# norm / LM head run outside the pipeline (they are not sharded over ``pp``,
+# and their compute is negligible next to the block stack), matching the
+# reference's first/last-stage carve-out (inference.py:101-127 feeds rank 0,
+# collects on the last rank).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _llama_stage_fn(config) -> Callable:
+    """Stable (per-config) stage function so eager pipeline calls hit the
+    compile cache; honors ``config.remat`` per layer like the unpipelined
+    ``LlamaModel`` path."""
+    from ..models.llama import LlamaBlock
+
+    block = LlamaBlock(config)
+
+    def one_layer(carry, layer_params):
+        h, positions = carry
+        h = block.apply({"params": layer_params}, h, positions)
+        return (h, positions), None
+
+    if config.remat:
+        one_layer = jax.checkpoint(one_layer, prevent_cse=False)
+
+    def stage_fn(local_layers, h):
+        positions = jnp.broadcast_to(
+            jnp.arange(h.shape[1], dtype=jnp.int32)[None, :], h.shape[:2]
+        )
+        (h, _), _ = jax.lax.scan(one_layer, (h, positions), local_layers)
+        return h
+
+    return stage_fn
+
+
+def llama_pipeline_forward(
+    config,
+    params: Any,
+    input_ids: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Pipelined equivalent of ``LlamaForCausalLM.apply`` (logits).
+
+    Requires ``config.scan_layers=True`` — the stacked block weights ARE the
+    pipeline stages.
+    """
+    from ..models.llama import rms_norm
+
+    if not config.scan_layers:
+        raise ValueError("pipeline parallelism requires scan_layers=True (stacked blocks)")
+    model_p = params["model"] if "model" in params else params
+    stacked = model_p["layers"]["block"]
+
+    embed = model_p["embed_tokens"]["embedding"]
+    x = jnp.take(embed, input_ids, axis=0).astype(config.dtype)
+
+    x = pipeline_apply(
+        _llama_stage_fn(config), stacked, x,
+        mesh=mesh, n_microbatches=n_microbatches, axis_name="pp",
+    )
+
+    x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        return x @ embed.T.astype(config.dtype)
+    return x @ params["lm_head"]["kernel"].astype(config.dtype)

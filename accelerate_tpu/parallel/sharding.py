@@ -136,6 +136,12 @@ def plan_parameter_sharding(
 
     cfg = parallelism_config or ParallelismConfig()
     tp_rules = tp_rules or []
+    # Pipeline stages: stacked scanned-layer weights (leading dim = layer) are
+    # sharded over ``pp`` so each stage holds its contiguous L/pp layers
+    # locally (parallel/pp.py hands shard_map exactly that slice). The mesh is
+    # the source of truth for the axis size — cfg may be defaulted.
+    pp_size = mesh.shape.get("pp", 1)
+    scan_layer_re = re.compile(r"(^|/)layers/")
     shards_params = False
     fsdp_axes: tuple[str, ...] = ()
     if fsdp_plugin is not None and fsdp_plugin.shards_params:
@@ -176,6 +182,14 @@ def plan_parameter_sharding(
                         spec_entries[d] = None
                 matched_tp = True
                 break
+        if (
+            pp_size > 1
+            and spec_entries
+            and spec_entries[0] is None
+            and scan_layer_re.search(name)
+            and leaf.shape[0] % pp_size == 0
+        ):
+            spec_entries[0] = "pp"
         if shards_params and fsdp_axes:
             used_axes = {a for e in spec_entries if e for a in (e if isinstance(e, tuple) else (e,))}
             free_fsdp = tuple(a for a in fsdp_axes if a not in used_axes)

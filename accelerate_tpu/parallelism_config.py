@@ -214,8 +214,10 @@ class ParallelismConfig:
         cfg = self.infer_missing_axis(n)
         # ``pp`` is a real (leading) mesh axis so stage sub-meshes are
         # contiguous device slices; the canonical GSPMD axes follow in the
-        # reference's order. PartitionSpecs never name ``pp`` — pipeline
-        # stages address their sub-mesh through parallel/pp.
+        # reference's order. The only tensors whose PartitionSpec names
+        # ``pp`` are stacked scanned-layer weights (sharded on the layer dim,
+        # parallel/sharding.py) — everything else addresses the pipeline
+        # through parallel/pp's shard_map schedule.
         axis_names = ("pp",) + MESH_AXIS_ORDER
         shape = (cfg.pp_size,) + tuple(cfg.axis_size(ax) for ax in MESH_AXIS_ORDER)
         platform = getattr(devices[0], "platform", "cpu")
