@@ -5,3 +5,11 @@ from .llama import (
     cross_entropy_loss,
     llama_tp_rules,
 )
+from .moe import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    MixtralModel,
+    MoeLayer,
+    mixtral_tp_rules,
+    moe_cross_entropy_loss,
+)

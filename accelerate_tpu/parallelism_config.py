@@ -141,6 +141,32 @@ class ParallelismConfig:
         return ("cp", "sp")
 
     @property
+    def ep_axes(self) -> tuple[str, ...]:
+        """Mesh axes the expert dim of MoE layers is sharded over.
+
+        ``ep`` borrows capacity from existing axes (no extra mesh dim — the
+        DeepSpeed-MoE / torchtitan pattern, SURVEY.md §2.3 EP row): whole axes
+        are taken greedily from ``(dp_shard, sp, tp)`` until their product is
+        exactly ``ep_size``. Sub-axis sharding is not expressible in a
+        PartitionSpec, so ``ep_size`` must be a product of full axis sizes."""
+        if self.ep_size == 1:
+            return ()
+        axes: list[str] = []
+        remaining = self.ep_size
+        for ax in ("dp_shard", "sp", "tp"):
+            size = self.axis_size(ax)
+            if size > 1 and remaining % size == 0:
+                axes.append(ax)
+                remaining //= size
+                if remaining == 1:
+                    return tuple(axes)
+        raise ValueError(
+            f"ep_size={self.ep_size} is not a product of whole mesh axes from "
+            f"(dp_shard={self.dp_shard_size}, sp={self.sp_size}, tp={self.tp_size}); "
+            "choose ep equal to such a product."
+        )
+
+    @property
     def loss_reduce_axes(self) -> tuple[str, ...]:
         """Axes a scalar loss must be averaged over — dp + cp + sp
         (reference: SP loss averaged across sp+dp ranks, SURVEY.md §2.3)."""
