@@ -40,3 +40,11 @@ from .data_loader import (  # noqa: E402
 from .optimizer import AcceleratedOptimizer  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .train_state import TrainState  # noqa: E402
+from .big_modeling import (  # noqa: E402
+    DispatchedModel,
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)

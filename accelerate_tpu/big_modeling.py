@@ -1,0 +1,355 @@
+"""Big-model inference: load + run models larger than one chip's HBM.
+
+TPU-native redesign of the reference's hook machinery (reference:
+big_modeling.py:62-662, hooks.py:242-719). The reference intercepts every
+``module.forward`` with ``AlignDevicesHook``s that fault weights in from
+CPU/disk and evict them after. Python-per-module hooks would destroy XLA
+fusion, so the equivalent here is *layer streaming*:
+
+- params live where the device map put them (HBM / host numpy / disk memmap);
+- the forward walks the model's layer stream plan, keeping at most two
+  decoder blocks resident: while block *i* computes on the chip, block
+  *i+1*'s weights ride the DMA in parallel (``jax.device_put`` is async),
+  which is the role of the reference's ``AlignDevicesHook`` prefetch;
+- each block reuses ONE jitted computation (identical shapes ⇒ one compile),
+  the same trick as the reference's regional compilation
+  (utils/other.py:106-177).
+
+Models without a registered stream plan fall back to materialize-per-call
+(exactly the reference's ``cpu_offload`` semantics, big_modeling.py:179-231).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .model import Model
+from .utils.modeling import (
+    _DiskHandle,
+    check_device_map,
+    compute_abstract_params,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    placement_for,
+)
+from .utils.offload import offload_state_dict
+from .utils.other import flatten_state_dict, unflatten_state_dict
+
+__all__ = [
+    "init_empty_weights",
+    "cpu_offload",
+    "disk_offload",
+    "dispatch_model",
+    "load_checkpoint_and_dispatch",
+    "DispatchedModel",
+    "register_stream_plan",
+]
+
+
+def init_empty_weights(module, *sample_args, rng=None, **sample_kwargs):
+    """Abstract-shape init — zero bytes allocated.
+
+    The functional counterpart of the reference's meta-device context manager
+    (big_modeling.py:62-178): returns a pytree of ``jax.ShapeDtypeStruct``
+    describing ``module.init``'s params.
+    """
+    return compute_abstract_params(module, *sample_args, rng=rng, **sample_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Param resolver: faults groups in from their placement, with async prefetch
+# ---------------------------------------------------------------------------
+
+
+class ParamResolver:
+    """Materialize param subtrees on the execution device on demand.
+
+    ``prefetch`` enqueues the H2D copy immediately and returns; ``take``
+    hands the arrays over and evicts them from the cache once consumed —
+    together they give the double-buffered pipeline the reference builds
+    with hook ``pre_forward``/``post_forward`` pairs (hooks.py:358-431).
+    """
+
+    def __init__(self, placed_params, device, sep: str = "/"):
+        self.placed = placed_params
+        self.device = device
+        self.sep = sep
+        self._cache: dict[str, Any] = {}
+
+    def _subtree(self, prefix: str):
+        node = self.placed
+        for part in prefix.split(self.sep):
+            node = node[part]
+        return node
+
+    def _materialize(self, node, layer_index: Optional[int] = None):
+        def _leaf(a):
+            if isinstance(a, _DiskHandle):
+                a = a.load()
+            if layer_index is not None:
+                a = a[layer_index]
+            if isinstance(a, jax.Array) and a.devices() == {self.device}:
+                return a
+            return jax.device_put(np.asarray(a) if isinstance(a, np.memmap) else a, self.device)
+
+        return jax.tree.map(_leaf, node)
+
+    def _key(self, prefix, layer_index):
+        return prefix if layer_index is None else f"{prefix}@{layer_index}"
+
+    def prefetch(self, prefix: str, layer_index: Optional[int] = None):
+        key = self._key(prefix, layer_index)
+        if key not in self._cache:
+            self._cache[key] = self._materialize(self._subtree(prefix), layer_index)
+
+    def take(self, prefix: str, layer_index: Optional[int] = None):
+        key = self._key(prefix, layer_index)
+        if key in self._cache:
+            return self._cache.pop(key)
+        return self._materialize(self._subtree(prefix), layer_index)
+
+    def peek(self, prefix: str, layer_index: Optional[int] = None):
+        """Like take but keeps resident (for groups already living on device)."""
+        key = self._key(prefix, layer_index)
+        if key not in self._cache:
+            self._cache[key] = self._materialize(self._subtree(prefix), layer_index)
+        return self._cache[key]
+
+
+# ---------------------------------------------------------------------------
+# Stream plans (per model family)
+# ---------------------------------------------------------------------------
+
+_STREAM_PLANS: dict[str, Callable] = {}
+_JIT_CACHE: dict[Any, Callable] = {}
+
+
+def register_stream_plan(module_class_name: str, fn: Callable):
+    """Register ``fn(module, resolver, *args) -> output`` as the streamed
+    forward for a model family."""
+    _STREAM_PLANS[module_class_name] = fn
+
+
+def _jit_for(key, fn):
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(fn)
+    return _JIT_CACHE[key]
+
+
+def _llama_stream_forward(module, resolver: ParamResolver, input_ids):
+    """Layer-streamed Llama forward: ≤2 blocks resident in HBM at once."""
+    import flax.linen as nn
+
+    from .models.llama import LlamaBlock, RMSNorm
+
+    cfg = module.config
+    input_ids = jnp.asarray(input_ids)
+
+    embed = nn.Embed(
+        cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+        name="embed_tokens",
+    )
+    # peek (not take) when tied: the table is reused by the head, one upload.
+    embed_params = (
+        resolver.peek("model/embed_tokens")
+        if cfg.tie_word_embeddings
+        else resolver.take("model/embed_tokens")
+    )
+    x = _jit_for((cfg, "embed"), lambda p, ids: embed.apply({"params": p}, ids))(
+        embed_params, input_ids
+    )
+    positions = jnp.broadcast_to(
+        jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :], input_ids.shape
+    )
+
+    block = LlamaBlock(cfg)
+    block_fn = _jit_for((cfg, "block"), lambda p, h, pos: block.apply({"params": p}, h, pos))
+    if cfg.scan_layers:
+        layer_args = [("model/layers/block", i) for i in range(cfg.num_hidden_layers)]
+    else:
+        layer_args = [(f"model/layers_{i}", None) for i in range(cfg.num_hidden_layers)]
+
+    resolver.prefetch(*layer_args[0])
+    for i, (prefix, idx) in enumerate(layer_args):
+        if i + 1 < len(layer_args):
+            resolver.prefetch(*layer_args[i + 1])  # DMA overlaps block i's compute
+        x = block_fn(resolver.take(prefix, idx), x, positions)
+
+    norm = RMSNorm(cfg.rms_norm_eps)
+    x = _jit_for((cfg, "norm"), lambda p, h: norm.apply({"params": p}, h))(
+        resolver.take("model/norm"), x
+    )
+    if cfg.tie_word_embeddings:
+        w = resolver.take("model/embed_tokens")["embedding"]  # still cached from embed step
+        return _jit_for((cfg, "tied_head"), lambda w, h: h @ w.T.astype(cfg.dtype))(w, x)
+    head = resolver.take("lm_head")
+    return _jit_for((cfg, "head"), lambda p, h: (h @ p["kernel"].astype(cfg.dtype)))(head, x)
+
+
+register_stream_plan("LlamaForCausalLM", _llama_stream_forward)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+
+class DispatchedModel(Model):
+    """A :class:`Model` whose params live across HBM / host / disk.
+
+    Forward picks the streamed plan when one is registered for the module
+    class; otherwise it materializes everything on the execution device for
+    the duration of the call (reference ``cpu_offload`` semantics).
+    """
+
+    def __init__(
+        self,
+        module,
+        placed_params,
+        device_map,
+        execution_device,
+        sep: str = "/",
+        apply_fn=None,
+        extra_state=None,
+    ):
+        super().__init__(
+            module=module, apply_fn=apply_fn, params=placed_params, extra_state=extra_state
+        )
+        self.device_map = dict(device_map)
+        self.execution_device = execution_device
+        self._sep = sep
+
+    def __call__(self, *args, **kwargs):
+        resolver = ParamResolver(self._params, self.execution_device, sep=self._sep)
+        plan = _STREAM_PLANS.get(type(self.module).__name__) if self.module is not None else None
+        if plan is not None and not self.extra_state:
+            return plan(self.module, resolver, *args, **kwargs)
+        full = resolver._materialize(self._params)
+        variables = {"params": full}
+        if self.extra_state:
+            variables.update(self.extra_state)
+        try:
+            return self.apply_fn(variables, *args, **kwargs)
+        finally:
+            del full  # evict the transient on-device copy
+
+    def hbm_resident_bytes(self) -> int:
+        """Bytes of params permanently resident on device (diagnostics)."""
+        total = 0
+        for leaf in jax.tree.leaves(self._params):
+            if isinstance(leaf, jax.Array):
+                total += leaf.nbytes
+        return total
+
+
+def dispatch_model(
+    model: Model,
+    device_map: Mapping[str, Any],
+    offload_dir: Optional[str] = None,
+    execution_device=None,
+    sep: str = "/",
+) -> DispatchedModel:
+    """Scatter an in-memory model's params per ``device_map``
+    (reference: big_modeling.py:315-521)."""
+    flat = flatten_state_dict(model.params, sep=sep)
+    # Normalize: int placements → local devices.
+    local = jax.local_devices()
+    device_map = {
+        k: (local[v] if isinstance(v, int) else v) for k, v in device_map.items()
+    }
+    placed: dict[str, Any] = {}
+    disk_entries: dict[str, np.ndarray] = {}
+    for name, arr in flat.items():
+        p = placement_for(name, device_map, sep=sep)
+        if p == "cpu":
+            placed[name] = np.asarray(arr)
+        elif p == "disk":
+            disk_entries[name] = np.asarray(arr)
+        else:
+            placed[name] = jax.device_put(arr, p)
+    if disk_entries:
+        if offload_dir is None:
+            raise ValueError("device_map contains 'disk' entries but no offload_dir given")
+        offload_state_dict(offload_dir, disk_entries)
+        for name, arr in disk_entries.items():
+            placed[name] = _DiskHandle(name, offload_dir, arr.shape, arr.dtype)
+    if execution_device is None:
+        devs = [d for d in device_map.values() if not isinstance(d, str)]
+        execution_device = devs[0] if devs else local[0]
+    return DispatchedModel(
+        model.module,
+        unflatten_state_dict(placed, sep=sep),
+        device_map,
+        execution_device,
+        sep=sep,
+        apply_fn=None if model.module is not None else model.apply_fn,
+        extra_state=model.extra_state,
+    )
+
+
+def cpu_offload(model: Model, execution_device=None) -> DispatchedModel:
+    """All params to host RAM; faulted to the chip per forward
+    (reference: big_modeling.py:179-231)."""
+    top = {k: "cpu" for k in model.params}
+    return dispatch_model(model, top, execution_device=execution_device)
+
+
+def disk_offload(model: Model, offload_dir: str, execution_device=None) -> DispatchedModel:
+    """All params to a disk memmap store (reference: big_modeling.py:233-276)."""
+    top = {k: "disk" for k in model.params}
+    return dispatch_model(model, top, offload_dir=offload_dir, execution_device=execution_device)
+
+
+def load_checkpoint_and_dispatch(
+    module,
+    checkpoint: str,
+    *sample_args,
+    device_map: Any = "auto",
+    max_memory: Optional[dict] = None,
+    no_split_modules: Optional[list[str]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    rng=None,
+    sep: str = "/",
+    **sample_kwargs,
+) -> DispatchedModel:
+    """Meta-init + auto device map + shard streaming, in one call
+    (reference: big_modeling.py:522-662).
+
+    The full model never exists in one memory: shards stream from disk
+    straight into their mapped placement.
+    """
+    abstract = compute_abstract_params(module, *sample_args, rng=rng, **sample_kwargs)
+    if device_map in ("auto", "balanced", "balanced_low_0"):
+        mm = (
+            get_balanced_memory(
+                abstract, max_memory, no_split_modules, dtype=dtype,
+                low_zero=(device_map == "balanced_low_0"),
+            )
+            if device_map in ("balanced", "balanced_low_0")
+            else get_max_memory(max_memory)
+        )
+        device_map = infer_auto_device_map(
+            abstract, mm, no_split_modules=no_split_modules, dtype=dtype, sep=sep
+        )
+    elif device_map is None:
+        device_map = {"": jax.local_devices()[0]}
+    else:
+        local = jax.local_devices()
+        device_map = {
+            k: (local[v] if isinstance(v, int) else v) for k, v in device_map.items()
+        }
+    check_device_map(abstract, device_map, sep=sep)
+    placed, _ = load_checkpoint_in_model(
+        abstract, checkpoint, device_map=device_map, offload_folder=offload_folder,
+        dtype=dtype, sep=sep,
+    )
+    devs = [d for d in device_map.values() if not isinstance(d, str)]
+    execution_device = devs[0] if devs else jax.local_devices()[0]
+    return DispatchedModel(module, placed, device_map, execution_device, sep=sep)

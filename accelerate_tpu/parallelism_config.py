@@ -151,15 +151,19 @@ class ParallelismConfig:
         PartitionSpec, so ``ep_size`` must be a product of full axis sizes."""
         if self.ep_size == 1:
             return ()
-        axes: list[str] = []
-        remaining = self.ep_size
-        for ax in ("dp_shard", "sp", "tp"):
-            size = self.axis_size(ax)
-            if size > 1 and remaining % size == 0:
-                axes.append(ax)
-                remaining //= size
-                if remaining == 1:
-                    return tuple(axes)
+        # Exhaustive subset search (candidate count ≤ 3 so 2^3 subsets):
+        # greedy-by-order can wrongly consume an early axis and then fail even
+        # though a later subset matches exactly. Prefer earlier axes on ties.
+        candidates = [ax for ax in ("dp_shard", "sp", "tp") if self.axis_size(ax) > 1]
+        from itertools import combinations
+
+        for r in range(1, len(candidates) + 1):
+            for combo in combinations(candidates, r):
+                prod = 1
+                for ax in combo:
+                    prod *= self.axis_size(ax)
+                if prod == self.ep_size:
+                    return tuple(combo)
         raise ValueError(
             f"ep_size={self.ep_size} is not a product of whole mesh axes from "
             f"(dp_shard={self.dp_shard_size}, sp={self.sp_size}, tp={self.tp_size}); "

@@ -1,0 +1,388 @@
+"""Model-placement utilities for big-model inference.
+
+TPU-native rethink of the reference's ``utils/modeling.py`` (reference:
+utils/modeling.py:227-2065). The reference mutates ``nn.Module`` objects,
+moving individual ``nn.Parameter``s between devices
+(``set_module_tensor_to_device``, utils/modeling.py:227-439). JAX separates
+architecture from state, so here everything operates on *param pytrees*:
+
+- abstract shapes come from ``jax.eval_shape`` (zero FLOPs, zero bytes — the
+  role of meta-device init, reference: big_modeling.py:62-178);
+- a *device map* assigns each named param group to a JAX device, ``"cpu"``
+  (host RAM as numpy) or ``"disk"`` (numpy memmap, see utils/offload.py);
+- checkpoint shards stream straight into their mapped placement so the full
+  model never materializes in host or device memory at once (the role of
+  ``load_checkpoint_in_model``, reference: utils/modeling.py:1805-2065).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Any, Mapping, Optional, Union
+
+import jax
+import numpy as np
+
+from .other import convert_bytes, flatten_state_dict, parse_bytes, unflatten_state_dict
+
+# A placement is a jax.Device, "cpu" (host numpy) or "disk" (memmap).
+Placement = Union[jax.Device, str]
+
+
+# ---------------------------------------------------------------------------
+# Abstract (meta) initialization
+# ---------------------------------------------------------------------------
+
+
+def compute_abstract_params(module, *sample_args, rng=None, **sample_kwargs):
+    """Shapes/dtypes of ``module.init`` without allocating anything.
+
+    The reference patches ``nn.Module.register_parameter`` to land params on
+    the meta device (big_modeling.py:62-178); ``jax.eval_shape`` is the
+    first-class equivalent: tracing ``init`` yields a pytree of
+    ``jax.ShapeDtypeStruct``.
+    """
+    if rng is None:
+        rng = jax.random.key(0)
+    variables = jax.eval_shape(lambda: module.init(rng, *sample_args, **sample_kwargs))
+    return variables["params"]
+
+
+def named_parameter_shapes(abstract_params, sep: str = "/") -> dict[str, jax.ShapeDtypeStruct]:
+    """Flat {"path/to/param": ShapeDtypeStruct} view of an abstract tree."""
+    flat = {}
+
+    def _walk(prefix, node):
+        if isinstance(node, Mapping):
+            for k in sorted(node):
+                _walk(f"{prefix}{sep}{k}" if prefix else k, node[k])
+        else:
+            flat[prefix] = node
+
+    _walk("", abstract_params)
+    return flat
+
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element, supporting sub-byte dtypes (int4)."""
+    dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
+    name = getattr(dtype, "name", str(dtype))
+    if "int4" in name or "uint4" in name:
+        return 0.5
+    return dtype.itemsize
+
+
+def tensor_bytes(t) -> int:
+    return int(np.prod(t.shape) * dtype_byte_size(t.dtype)) if t.shape else int(dtype_byte_size(t.dtype))
+
+
+def compute_module_sizes(abstract_params, dtype=None, sep: str = "/") -> dict[str, int]:
+    """Bytes per module prefix, including ``""`` for the whole model.
+
+    Mirrors reference utils/modeling.py:718-772: every ancestor prefix of a
+    param accumulates its size, so the map can be queried at any granularity.
+    ``dtype`` overrides the stored dtype (the reference's load-time dtype cast).
+    """
+    sizes: dict[str, int] = defaultdict(int)
+    for name, spec in named_parameter_shapes(abstract_params, sep=sep).items():
+        size = int(np.prod(spec.shape) * dtype_byte_size(dtype or spec.dtype))
+        sizes[""] += size
+        parts = name.split(sep)
+        for i in range(1, len(parts) + 1):
+            sizes[sep.join(parts[:i])] += size
+    return dict(sizes)
+
+
+def calculate_maximum_sizes(abstract_params, sep: str = "/"):
+    """(total_bytes, (largest_leaf_module_bytes, name)) — the two numbers the
+    ``estimate-memory`` CLI reports (reference: commands/estimate.py:66-318)."""
+    sizes = compute_module_sizes(abstract_params, sep=sep)
+    total = sizes[""]
+    leaf_names = named_parameter_shapes(abstract_params, sep=sep)
+    modules = {sep.join(n.split(sep)[:-1]) or n: 0 for n in leaf_names}
+    for m in modules:
+        modules[m] = sizes.get(m, 0)
+    biggest = max(modules.items(), key=lambda kv: kv[1]) if modules else ("", 0)
+    return total, (biggest[1], biggest[0])
+
+
+# ---------------------------------------------------------------------------
+# Memory budgets
+# ---------------------------------------------------------------------------
+
+_DEFAULT_HBM = 16 * 1024**3  # v5e chip when the backend exposes no stats
+
+
+def get_max_memory(max_memory: Optional[dict] = None) -> dict[Any, int]:
+    """{device_index: bytes, "cpu": bytes} budget map.
+
+    Like reference utils/modeling.py:828-930 but reading HBM from the JAX
+    device API (``memory_stats()["bytes_limit"]``) instead of
+    ``torch.cuda.mem_get_info``. User entries accept "10GiB"-style strings.
+    """
+    if max_memory is not None:
+        return {k: parse_bytes(v) if isinstance(v, (str, int)) else v for k, v in max_memory.items()}
+    out: dict[Any, int] = {}
+    for i, d in enumerate(jax.local_devices()):
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        limit = (stats or {}).get("bytes_limit", _DEFAULT_HBM)
+        # Keep ~10% headroom for XLA scratch, like the reference's 90% rule.
+        out[i] = int(limit * 0.9)
+    try:
+        cpu_bytes = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        cpu_bytes = 32 * 1024**3
+    out["cpu"] = int(cpu_bytes * 0.9)
+    return out
+
+
+def get_balanced_memory(
+    abstract_params,
+    max_memory: Optional[dict] = None,
+    no_split_modules: Optional[list[str]] = None,
+    dtype=None,
+    low_zero: bool = False,
+) -> dict[Any, int]:
+    """Even out per-device budgets so layers spread instead of greedily filling
+    device 0 (reference: utils/modeling.py:931-1066). ``low_zero`` keeps
+    device 0 light for generation-time KV-cache/IO headroom."""
+    max_memory = get_max_memory(max_memory)
+    devices = [k for k in max_memory if k not in ("cpu", "disk")]
+    if len(devices) <= 1:
+        return max_memory
+    sizes = compute_module_sizes(abstract_params, dtype=dtype)
+    n = len(devices) - (1 if low_zero else 0)
+    per_device = sizes[""] // n
+    # Leave room for the largest indivisible block on each device. Same
+    # matching rule as infer_auto_device_map: regex fullmatch (or equality)
+    # on the last path segment.
+    leaves = [
+        sizes[m]
+        for m in sizes
+        if m
+        and no_split_modules
+        and any(
+            re.fullmatch(pat, m.split("/")[-1]) or m.split("/")[-1] == pat
+            for pat in no_split_modules
+        )
+    ]
+    buffer = max(leaves) if leaves else max(
+        (sizes[m] for m in sizes if m and "/" not in m), default=0
+    )
+    target = per_device + buffer
+    out = dict(max_memory)
+    for d in devices:
+        cap = 0 if (low_zero and d == devices[0]) else target
+        out[d] = min(max_memory[d], cap) if cap else max_memory[d]
+    if low_zero:
+        out[devices[0]] = min(max_memory[devices[0]], buffer)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-map inference
+# ---------------------------------------------------------------------------
+
+
+def infer_auto_device_map(
+    abstract_params,
+    max_memory: Optional[dict] = None,
+    no_split_modules: Optional[list[str]] = None,
+    dtype=None,
+    offload_buffers: bool = False,
+    sep: str = "/",
+) -> dict[str, Placement]:
+    """Greedy top-down packing of param groups onto device budgets.
+
+    The reference walks named modules in declaration order, filling GPU 0,
+    then 1, …, then "cpu", then "disk" (utils/modeling.py:1295-1602). Here
+    groups are the pytree's nested prefixes; a group that doesn't fit on the
+    current budget is split into its children unless its *name* matches
+    ``no_split_modules`` (the ``no_split_module_classes`` role — flax scopes
+    are named after their module class instances).
+    """
+    max_memory = get_max_memory(max_memory)
+    no_split = no_split_modules or []
+    budgets: list[tuple[Any, int]] = [
+        (k, v) for k, v in max_memory.items() if k not in ("cpu", "disk")
+    ]
+    budgets.sort(key=lambda kv: kv[0])
+    budgets.append(("cpu", max_memory.get("cpu", 0)))
+    budgets.append(("disk", float("inf")))
+
+    sizes = compute_module_sizes(abstract_params, dtype=dtype, sep=sep)
+    device_map: dict[str, Placement] = {}
+    cursor = 0
+    remaining = [b for _, b in budgets]
+
+    def _splittable(name: str, node) -> bool:
+        if not isinstance(node, Mapping):
+            return False
+        leaf = name.split(sep)[-1]
+        return not any(re.fullmatch(pat, leaf) or leaf == pat for pat in no_split)
+
+    def _assign(name: str, node):
+        nonlocal cursor
+        size = sizes.get(name, 0)
+        while cursor < len(remaining):
+            if size <= remaining[cursor]:
+                remaining[cursor] -= size
+                device_map[name] = budgets[cursor][0]
+                return
+            if _splittable(name, node):
+                for k in sorted(node):
+                    _assign(f"{name}{sep}{k}", node[k])
+                return
+            cursor += 1
+        raise MemoryError(f"Could not place module {name!r} ({convert_bytes(size)}) anywhere.")
+
+    for k in sorted(abstract_params):
+        _assign(k, abstract_params[k])
+    # jax.Device placements instead of bare indices for device entries.
+    local = jax.local_devices()
+    return {
+        name: (local[p] if isinstance(p, int) else p) for name, p in device_map.items()
+    }
+
+
+def check_device_map(abstract_params, device_map: Mapping[str, Placement], sep: str = "/"):
+    """Every param must be covered by exactly one device-map prefix
+    (reference: utils/modeling.py:1604-1639)."""
+    names = list(named_parameter_shapes(abstract_params, sep=sep))
+    for n in names:
+        hits = [p for p in device_map if n == p or n.startswith(p + sep)]
+        if len(hits) == 0:
+            raise ValueError(f"Param {n!r} not covered by device_map")
+        if len(hits) > 1:
+            # Nested prefixes: the longest match wins; overlap of distinct
+            # non-nested prefixes is a config error.
+            hits.sort(key=len)
+            for a, b in zip(hits, hits[1:]):
+                if not b.startswith(a + sep) and a != b:
+                    raise ValueError(f"Param {n!r} covered by overlapping entries {hits}")
+
+
+def placement_for(name: str, device_map: Mapping[str, Placement], sep: str = "/") -> Placement:
+    """Longest-prefix lookup of a param's placement."""
+    best, best_len = None, -1
+    for prefix, placement in device_map.items():
+        if (name == prefix or name.startswith(prefix + sep)) and len(prefix) > best_len:
+            best, best_len = placement, len(prefix)
+    if best is None:
+        raise KeyError(f"No device_map entry covers {name!r}")
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Placement + checkpoint streaming
+# ---------------------------------------------------------------------------
+
+
+def place_tensor(array: np.ndarray, placement: Placement, target_dtype=None):
+    """The ``set_module_tensor_to_device`` role (reference:
+    utils/modeling.py:227-439): land one weight in its mapped home."""
+    if target_dtype is not None:
+        array = np.asarray(array).astype(target_dtype) if array.dtype != target_dtype else array
+    if placement == "cpu":
+        return np.asarray(array)
+    if placement == "disk":
+        return array  # caller routes to the offload store
+    return jax.device_put(array, placement)
+
+
+def load_checkpoint_in_model(
+    abstract_params,
+    checkpoint: str,
+    device_map: Optional[Mapping[str, Placement]] = None,
+    offload_folder: Optional[str] = None,
+    dtype=None,
+    sep: str = "/",
+):
+    """Stream a (possibly sharded) safetensors checkpoint into placements.
+
+    Returns ``(params_tree, disk_index)``: tree leaves are jax Arrays (device
+    entries), numpy arrays ("cpu") or ``OffloadedWeight`` handles ("disk",
+    backed by ``offload_folder``). Shards are read one at a time so peak host
+    memory is one shard (reference: utils/modeling.py:1805-2065).
+    """
+    from .offload import offload_weight, save_offload_index
+
+    shapes = named_parameter_shapes(abstract_params, sep=sep)
+    if device_map is None:
+        device_map = {"": jax.local_devices()[0]}
+    check_device_map(abstract_params, device_map, sep=sep)
+
+    index_file = os.path.join(checkpoint, "model.safetensors.index.json")
+    if os.path.isdir(checkpoint) and os.path.isfile(index_file):
+        with open(index_file) as f:
+            index = json.load(f)
+        shard_files = sorted(set(index["weight_map"].values()))
+        shards = [os.path.join(checkpoint, s) for s in shard_files]
+    elif os.path.isdir(checkpoint):
+        shards = [
+            os.path.join(checkpoint, f)
+            for f in sorted(os.listdir(checkpoint))
+            if f.endswith(".safetensors")
+        ]
+    else:
+        shards = [checkpoint]
+
+    flat_out: dict[str, Any] = {}
+    disk_index: dict[str, dict] = {}
+    from safetensors.numpy import load_file
+
+    for shard in shards:
+        loaded = load_file(shard)
+        for name, arr in loaded.items():
+            if name not in shapes:
+                continue  # tolerated extra weight (reference warns + skips)
+            placement = placement_for(name, device_map, sep=sep)
+            want = shapes[name]
+            if tuple(arr.shape) != tuple(want.shape):
+                raise ValueError(
+                    f"Checkpoint weight {name!r} has shape {tuple(arr.shape)} but the "
+                    f"model expects {tuple(want.shape)}"
+                )
+            cast = dtype or want.dtype
+            if arr.dtype != cast:
+                arr = arr.astype(cast)
+            if placement == "disk":
+                if offload_folder is None:
+                    raise ValueError("device_map contains 'disk' entries but no offload_folder given")
+                disk_index[name] = offload_weight(arr, name, offload_folder)
+                flat_out[name] = _DiskHandle(name, offload_folder, arr.shape, arr.dtype)
+            else:
+                flat_out[name] = place_tensor(arr, placement)
+        del loaded
+    missing = sorted(set(shapes) - set(flat_out))
+    if missing:
+        raise ValueError(f"Checkpoint {checkpoint} is missing weights: {missing[:8]}…")
+    if disk_index:
+        save_offload_index(disk_index, offload_folder)
+    return unflatten_state_dict(flat_out, sep=sep), disk_index
+
+
+class _DiskHandle:
+    """Lazy leaf standing in for a disk-offloaded weight."""
+
+    __slots__ = ("name", "folder", "shape", "dtype")
+
+    def __init__(self, name, folder, shape, dtype):
+        self.name, self.folder, self.shape, self.dtype = name, folder, shape, np.dtype(dtype)
+
+    def load(self) -> np.ndarray:
+        from .offload import load_offloaded_weight
+
+        return load_offloaded_weight(
+            self.folder, self.name, {"shape": list(self.shape), "dtype": self.dtype.name}
+        )
+
+    def __repr__(self):
+        return f"_DiskHandle({self.name!r}, shape={self.shape}, dtype={self.dtype})"

@@ -1,0 +1,110 @@
+"""Disk offload store: numpy memmaps + a json index.
+
+Same on-disk contract as the reference (utils/offload.py:25-213): one
+``<name>.dat`` raw memmap per weight plus ``index.json`` carrying shape and
+dtype, so offloaded weights can be mapped back lazily with O(1) host memory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Any, Optional
+
+import numpy as np
+
+
+def offload_weight(weight: np.ndarray, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """Write one weight to ``<folder>/<name>.dat``; return its index entry
+    (reference: utils/offload.py:25-47)."""
+    os.makedirs(offload_folder, exist_ok=True)
+    dtype = np.dtype(weight.dtype)
+    entry = {"dtype": dtype.name, "shape": list(weight.shape)}
+    path = os.path.join(offload_folder, f"{weight_name.replace('/', '--')}.dat")
+    shape = tuple(weight.shape) or (1,)
+    mm = np.memmap(path, dtype=dtype, mode="w+", shape=shape)
+    mm[:] = np.asarray(weight).reshape(shape)[:]
+    mm.flush()
+    if index is not None:
+        index[weight_name] = entry
+    return entry
+
+
+def load_offloaded_weight(offload_folder: str, weight_name: str, weight_info: Mapping[str, Any]) -> np.ndarray:
+    """Memmap one weight back (reference: utils/offload.py:50-68)."""
+    path = os.path.join(offload_folder, f"{weight_name.replace('/', '--')}.dat")
+    shape = tuple(weight_info["shape"]) or (1,)
+    mm = np.memmap(path, dtype=np.dtype(weight_info["dtype"]), mode="r", shape=shape)
+    if not weight_info["shape"]:
+        return np.asarray(mm[0])
+    return mm
+
+
+def save_offload_index(index: Mapping[str, Any], offload_folder: str):
+    os.makedirs(offload_folder, exist_ok=True)
+    path = os.path.join(offload_folder, "index.json")
+    current = {}
+    if os.path.isfile(path):
+        with open(path) as f:
+            current = json.load(f)
+    current.update(index)
+    with open(path, "w") as f:
+        json.dump(current, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    path = os.path.join(offload_folder, "index.json")
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping[str, np.ndarray]) -> dict:
+    """Offload a whole flat state dict; returns the index
+    (reference: utils/offload.py:71-95)."""
+    index: dict = {}
+    for name, w in state_dict.items():
+        offload_weight(np.asarray(w), name, save_dir, index=index)
+    save_offload_index(index, save_dir)
+    return index
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy read-through Mapping over {in-memory state dict} ∪ {offload dir}
+    (reference: utils/offload.py:98-168)."""
+
+    def __init__(
+        self,
+        state_dict: Optional[Mapping[str, np.ndarray]] = None,
+        save_folder: Optional[str] = None,
+        index: Optional[Mapping[str, Any]] = None,
+    ):
+        if state_dict is None and save_folder is None:
+            raise ValueError("Need either a state_dict or a save_folder")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        self.index = dict(index if index is not None else (load_offload_index(save_folder) if save_folder else {}))
+        self.all_keys = sorted(set(self.state_dict) | set(self.index))
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        if key in self.state_dict:
+            return self.state_dict[key]
+        return load_offloaded_weight(self.save_folder, key, self.index[key])
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)
+
+
+def extract_submodule_tensors(loader: Mapping, prefixes: list[str], sep: str = "/") -> dict:
+    """Sub-view of a weights mapping per module prefix
+    (the ``extract_submodules_state_dict`` role, utils/offload.py:171-213)."""
+    out = {}
+    for key in loader:
+        if any(key == p or key.startswith(p + sep) for p in prefixes):
+            out[key] = loader[key]
+    return out
