@@ -40,6 +40,7 @@ from .data_loader import (  # noqa: E402
 from .optimizer import AcceleratedOptimizer  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .train_state import TrainState  # noqa: E402
+from .launchers import debug_launcher, notebook_launcher  # noqa: E402
 from .big_modeling import (  # noqa: E402
     DispatchedModel,
     cpu_offload,

@@ -32,10 +32,12 @@ from .utils.modeling import (
     _DiskHandle,
     check_device_map,
     compute_abstract_params,
+    default_execution_device,
     get_balanced_memory,
     get_max_memory,
     infer_auto_device_map,
     load_checkpoint_in_model,
+    normalize_device_map,
     placement_for,
 )
 from .utils.offload import offload_state_dict
@@ -258,11 +260,7 @@ def dispatch_model(
     """Scatter an in-memory model's params per ``device_map``
     (reference: big_modeling.py:315-521)."""
     flat = flatten_state_dict(model.params, sep=sep)
-    # Normalize: int placements → local devices.
-    local = jax.local_devices()
-    device_map = {
-        k: (local[v] if isinstance(v, int) else v) for k, v in device_map.items()
-    }
+    device_map = normalize_device_map(device_map)
     placed: dict[str, Any] = {}
     disk_entries: dict[str, np.ndarray] = {}
     for name, arr in flat.items():
@@ -280,8 +278,7 @@ def dispatch_model(
         for name, arr in disk_entries.items():
             placed[name] = _DiskHandle(name, offload_dir, arr.shape, arr.dtype)
     if execution_device is None:
-        devs = [d for d in device_map.values() if not isinstance(d, str)]
-        execution_device = devs[0] if devs else local[0]
+        execution_device = default_execution_device(device_map)
     return DispatchedModel(
         model.module,
         unflatten_state_dict(placed, sep=sep),
@@ -341,15 +338,11 @@ def load_checkpoint_and_dispatch(
     elif device_map is None:
         device_map = {"": jax.local_devices()[0]}
     else:
-        local = jax.local_devices()
-        device_map = {
-            k: (local[v] if isinstance(v, int) else v) for k, v in device_map.items()
-        }
+        device_map = normalize_device_map(device_map)
     check_device_map(abstract, device_map, sep=sep)
     placed, _ = load_checkpoint_in_model(
         abstract, checkpoint, device_map=device_map, offload_folder=offload_folder,
         dtype=dtype, sep=sep,
     )
-    devs = [d for d in device_map.values() if not isinstance(d, str)]
-    execution_device = devs[0] if devs else jax.local_devices()[0]
+    execution_device = default_execution_device(device_map)
     return DispatchedModel(module, placed, device_map, execution_device, sep=sep)

@@ -1,0 +1,7 @@
+"""`accelerate-tpu` CLI (layer L10).
+
+TPU-native re-design of the reference CLI (reference: src/accelerate/commands/):
+``config`` questionnaire, ``launch`` process fan-out over the JAX coordinator
+env contract, ``env`` report, ``test`` sanity suite, ``estimate-memory``
+abstract-shape sizing, and ``merge-weights`` sharded-checkpoint consolidation.
+"""

@@ -1,0 +1,29 @@
+"""CLI entry point (reference: commands/accelerate_cli.py)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import config, env, estimate, launch, merge, test
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="accelerate-tpu",
+        description="accelerate-tpu command line interface",
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    for mod in (config, env, launch, test, estimate, merge):
+        mod.add_parser(subparsers)
+    return parser
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""`accelerate-tpu config` — interactive questionnaire → config file.
+
+Reference analog: commands/config/cluster.py (939 LoC questionnaire) +
+commands/config/default.py. The TPU question tree is much smaller: one
+backend, parallelism degrees, precision, FSDP policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .config_args import LaunchConfig, default_config_file
+
+
+def _ask(prompt: str, default, cast=str, choices=None):
+    suffix = f" [{default}]"
+    if choices:
+        suffix = f" ({'/'.join(str(c) for c in choices)}){suffix}"
+    while True:
+        raw = input(f"{prompt}{suffix}: ").strip()
+        if not raw:
+            return default
+        try:
+            val = cast(raw)
+        except ValueError:
+            print(f"  invalid value {raw!r}, expected {cast.__name__}")
+            continue
+        if choices and val not in choices:
+            print(f"  must be one of {choices}")
+            continue
+        return val
+
+
+def _ask_bool(prompt: str, default: bool) -> bool:
+    raw = input(f"{prompt} (yes/no) [{'yes' if default else 'no'}]: ").strip().lower()
+    if not raw:
+        return default
+    return raw in ("y", "yes", "true", "1")
+
+
+def interactive_config() -> LaunchConfig:
+    cfg = LaunchConfig()
+    cfg.compute_environment = _ask(
+        "Compute environment", "LOCAL_MACHINE", str, ["LOCAL_MACHINE", "TPU_POD"]
+    )
+    if cfg.compute_environment == "TPU_POD":
+        cfg.num_machines = _ask("How many hosts (TPU VM workers)?", 1, int)
+        cfg.num_processes = cfg.num_machines
+        if cfg.num_machines > 1:
+            cfg.main_process_ip = _ask("Coordinator (worker 0) IP", "", str) or None
+            cfg.main_process_port = _ask("Coordinator port", 8476, int)
+    else:
+        cfg.num_processes = _ask("How many processes (hosts) in total?", 1, int)
+        if cfg.num_processes > 1:
+            cfg.main_process_port = _ask("Coordinator port", 8476, int)
+    cfg.use_cpu = _ask_bool("Run on CPU only (no TPU)?", False)
+    if cfg.use_cpu:
+        cfg.virtual_devices = _ask(
+            "Virtual CPU devices per process (0 = real devices only)", 0, int
+        )
+
+    print("-- Parallelism (sizes multiply to the device count; 1 = off) --")
+    cfg.dp_shard_size = _ask("FSDP/ZeRO shard degree (dp_shard)", 1, int)
+    cfg.dp_replicate_size = _ask("Replicated data-parallel degree (dp_replicate)", 1, int)
+    cfg.tp_size = _ask("Tensor-parallel degree (tp)", 1, int)
+    cfg.cp_size = _ask("Context-parallel / ring-attention degree (cp)", 1, int)
+    if cfg.cp_size == 1:
+        cfg.sp_size = _ask("Ulysses sequence-parallel degree (sp)", 1, int)
+    cfg.pp_size = _ask("Pipeline-parallel degree (pp)", 1, int)
+    cfg.ep_size = _ask("Expert-parallel degree (ep, MoE only)", 1, int)
+
+    cfg.use_fsdp = cfg.dp_shard_size > 1 or _ask_bool("Enable FSDP-style sharding?", False)
+    if cfg.use_fsdp:
+        cfg.fsdp_sharding_strategy = _ask(
+            "Sharding strategy",
+            "FULL_SHARD",
+            str,
+            ["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"],
+        )
+        cfg.fsdp_offload_params = _ask_bool("Offload optimizer state to host memory?", False)
+        cfg.fsdp_activation_checkpointing = _ask_bool("Activation checkpointing?", False)
+
+    cfg.mixed_precision = _ask(
+        "Mixed precision", "bf16", str, ["no", "bf16", "fp16", "fp8"]
+    )
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
+    return cfg
+
+
+def write_default_config(path: str | None = None, mixed_precision: str = "bf16") -> str:
+    """Non-interactive: one process, all local devices, bf16 — the
+    `accelerate config default` analog."""
+    cfg = LaunchConfig(mixed_precision=mixed_precision)
+    return cfg.save(path)
+
+
+def config_command(args: argparse.Namespace) -> int:
+    if getattr(args, "default", False):
+        path = write_default_config(args.config_file, args.mixed_precision)
+    else:
+        cfg = interactive_config()
+        path = cfg.save(args.config_file)
+    print(f"accelerate-tpu configuration saved at {path}")
+    return 0
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("config", help="Create a launch configuration file")
+    p.add_argument("--config_file", default=None, help=f"Output path (default: {default_config_file()})")
+    p.add_argument("--default", action="store_true", help="Write a non-interactive default config")
+    p.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16", "fp8"])
+    p.set_defaults(func=config_command)
+    return p

@@ -1,0 +1,161 @@
+"""Launch-config file handling (reference: commands/config/config_args.py:1-252).
+
+One flat dataclass persisted as YAML (or JSON). Priority when launching:
+CLI flags > config file > interactive defaults — same merge order as the
+reference (`_validate_launch_command`, reference: commands/launch.py:1196-1383).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.config_paths import cache_dir, default_config_file
+
+
+def load_config_file(config_file: Optional[str] = None) -> dict:
+    """Load a launch config as a plain dict; {} if the file doesn't exist."""
+    path = config_file or default_config_file()
+    if not os.path.isfile(path):
+        # Also accept a sibling .yaml/.json variant of the default path.
+        base, _ = os.path.splitext(path)
+        for ext in (".yaml", ".yml", ".json"):
+            if os.path.isfile(base + ext):
+                path = base + ext
+                break
+        else:
+            return {}
+    with open(path) as f:
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            return yaml.safe_load(f) or {}
+        return json.load(f)
+
+
+@dataclass
+class LaunchConfig:
+    """Everything `accelerate-tpu launch` needs to bring up a (multi-host) run."""
+
+    compute_environment: str = "LOCAL_MACHINE"  # LOCAL_MACHINE | TPU_POD
+    num_processes: int = 1          # total JAX processes (1 per host on a pod)
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    mixed_precision: str = "no"     # no | bf16 | fp16 | fp8
+    use_cpu: bool = False
+    debug: bool = False
+    gradient_accumulation_steps: int = 1
+    # Parallelism degrees (ParallelismConfig surface).
+    dp_replicate_size: int = 1
+    dp_shard_size: int = 1
+    tp_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    ep_size: int = 1
+    pp_size: int = 1
+    # FSDP/ZeRO policy.
+    use_fsdp: bool = False
+    fsdp_sharding_strategy: str = "FULL_SHARD"
+    fsdp_offload_params: bool = False
+    fsdp_state_dict_type: str = "SHARDED_STATE_DICT"
+    fsdp_activation_checkpointing: bool = False
+    # Compilation policy.
+    remat_policy: str = "none"
+    scan_layers: bool = True
+    jit_cache_dir: Optional[str] = None
+    # Virtual-device simulation: >0 forces JAX_PLATFORMS=cpu with this many
+    # host devices per process (CI / laptops without a TPU).
+    virtual_devices: int = 0
+    extra_env: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LaunchConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        unknown = {k: v for k, v in d.items() if k not in known}
+        cfg = cls(**kwargs)
+        if unknown:
+            cfg.extra_env.update({k: str(v) for k, v in unknown.items() if isinstance(v, (str, int, float, bool))})
+        return cfg
+
+    @classmethod
+    def from_file(cls, config_file: Optional[str] = None) -> "LaunchConfig":
+        return cls.from_dict(load_config_file(config_file))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or default_config_file()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        payload = self.to_dict()
+        with open(path, "w") as f:
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+
+                yaml.safe_dump(payload, f, sort_keys=False)
+            else:
+                json.dump(payload, f, indent=2)
+        return path
+
+    # ------------------------------------------------------------------
+    # Env encoding — the worker-side contract (state.py / dataclasses.py
+    # decode these; reference analog: utils/launch.py:201-427).
+    # ------------------------------------------------------------------
+
+    def to_env(self) -> dict[str, str]:
+        env: dict[str, str] = {
+            "ACCELERATE_MIXED_PRECISION": self.mixed_precision,
+            "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
+            "ACCELERATE_REMAT_POLICY": self.remat_policy,
+            "ACCELERATE_SCAN_LAYERS": str(self.scan_layers).lower(),
+        }
+        if self.debug:
+            env["ACCELERATE_DEBUG_MODE"] = "true"
+        if self.jit_cache_dir:
+            env["ACCELERATE_JIT_CACHE_DIR"] = self.jit_cache_dir
+        if self.use_fsdp or self.dp_shard_size > 1:
+            env["ACCELERATE_USE_FSDP"] = "true"
+            env["FSDP_SHARDING_STRATEGY"] = self.fsdp_sharding_strategy
+            env["FSDP_OFFLOAD_PARAMS"] = str(self.fsdp_offload_params).lower()
+            env["FSDP_STATE_DICT_TYPE"] = self.fsdp_state_dict_type
+            env["FSDP_ACTIVATION_CHECKPOINTING"] = str(self.fsdp_activation_checkpointing).lower()
+        parallel = {
+            "PARALLELISM_CONFIG_DP_REPLICATE_SIZE": self.dp_replicate_size,
+            "PARALLELISM_CONFIG_DP_SHARD_SIZE": self.dp_shard_size,
+            "PARALLELISM_CONFIG_TP_SIZE": self.tp_size,
+            "PARALLELISM_CONFIG_CP_SIZE": self.cp_size,
+            "PARALLELISM_CONFIG_SP_SIZE": self.sp_size,
+            "PARALLELISM_CONFIG_EP_SIZE": self.ep_size,
+            "PARALLELISM_CONFIG_PP_SIZE": self.pp_size,
+        }
+        if any(v > 1 for v in parallel.values()):
+            env.update({k: str(v) for k, v in parallel.items()})
+        if self.use_cpu or self.virtual_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+        if self.virtual_devices:
+            prev = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+            env["XLA_FLAGS"] = (
+                f"{prev} --xla_force_host_platform_device_count={self.virtual_devices}"
+            ).strip()
+        env.update({k: str(v) for k, v in self.extra_env.items()})
+        return env
+
+
+def describe_config(cfg: LaunchConfig) -> str:
+    lines = [f"  {k}: {v}" for k, v in cfg.to_dict().items() if k != "extra_env"]
+    return "\n".join(lines)
+
+
+__all__ = [
+    "LaunchConfig",
+    "load_config_file",
+    "default_config_file",
+    "cache_dir",
+    "describe_config",
+]

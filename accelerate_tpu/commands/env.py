@@ -1,0 +1,69 @@
+"""`accelerate-tpu env` — environment report (reference: commands/env.py)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+
+from .config_args import default_config_file, load_config_file
+
+
+def env_command(args: argparse.Namespace) -> int:
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+    }
+    try:
+        import jax
+
+        info["JAX version"] = jax.__version__
+        try:
+            devices = jax.devices()
+            info["JAX backend"] = devices[0].platform
+            info["Device count"] = len(devices)
+            info["Devices"] = ", ".join(str(d) for d in devices[:8]) + (
+                " ..." if len(devices) > 8 else ""
+            )
+            info["Process count"] = jax.process_count()
+        except Exception as e:  # no devices reachable is still a valid report
+            info["JAX devices"] = f"unavailable ({e})"
+    except ImportError:
+        info["JAX version"] = "not installed"
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            info[f"{mod} version"] = getattr(m, "__version__", "unknown")
+        except ImportError:
+            info[f"{mod} version"] = "not installed"
+
+    relevant_env = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_", "JAX_", "XLA_", "LIBTPU"))
+    }
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for k, v in info.items():
+        print(f"- {k}: {v}")
+    config = load_config_file(args.config_file)
+    print(f"- Config file ({args.config_file or default_config_file()}): "
+          f"{'present' if config else 'not found'}")
+    if config:
+        print("  " + json.dumps(config, indent=2).replace("\n", "\n  "))
+    if relevant_env:
+        print("- Environment variables:")
+        for k, v in sorted(relevant_env.items()):
+            print(f"  - {k}={v}")
+    return 0
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("env", help="Print environment information for bug reports")
+    p.add_argument("--config_file", default=None)
+    p.set_defaults(func=env_command)
+    return p

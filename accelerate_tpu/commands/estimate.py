@@ -1,0 +1,165 @@
+"""`accelerate-tpu estimate-memory` — dtype-wise memory sizing without weights.
+
+Reference analog: commands/estimate.py:66-318 (meta-device load of a Hub model,
+report param/grad/optimizer sizes per dtype). Here sizing comes from abstract
+shapes (`jax.eval_shape` for in-framework models; tensor headers for
+safetensors checkpoints; transformers config arithmetic for Hub configs) — no
+weights are ever materialized.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from ..utils.other import convert_bytes
+
+DTYPE_BYTES = {"fp32": 4, "bf16": 2, "fp16": 2, "fp8": 1, "int8": 1, "int4": 0.5}
+
+
+def _params_from_safetensors(path: str) -> tuple[int, int]:
+    """(total_params, largest_tensor_params) from safetensors header(s) only."""
+    import struct
+
+    files = []
+    if os.path.isdir(path):
+        idx = [f for f in os.listdir(path) if f.endswith(".index.json")]
+        if idx:
+            with open(os.path.join(path, idx[0])) as f:
+                files = sorted(
+                    {os.path.join(path, v) for v in json.load(f)["weight_map"].values()}
+                )
+        else:
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+            )
+    else:
+        files = [path]
+    total = largest = 0
+    for fpath in files:
+        with open(fpath, "rb") as f:
+            header_len = struct.unpack("<Q", f.read(8))[0]
+            header = json.loads(f.read(header_len))
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            n = 1
+            for d in meta["shape"]:
+                n *= d
+            total += n
+            largest = max(largest, n)
+    return total, largest
+
+
+def _params_from_builtin(spec: str):
+    """'llama:7b' / 'llama:1b' / 'llama:tiny' / 'mixtral:tiny' →
+    (total, largest) via jax.eval_shape (no FLOPs, no memory)."""
+    import jax
+    import numpy as np
+
+    from ..utils.modeling import compute_abstract_params, named_parameter_shapes
+
+    family, _, size = spec.partition(":")
+    size = size or "tiny"
+    if family == "llama":
+        from ..models import LlamaConfig, LlamaForCausalLM
+
+        ctor = {"7b": LlamaConfig.llama_7b, "1b": LlamaConfig.llama_1b, "tiny": LlamaConfig.tiny}
+        cfg = ctor[size]()
+        module = LlamaForCausalLM(cfg)
+    elif family == "mixtral":
+        from ..models import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig.tiny() if size == "tiny" else MixtralConfig(**json.loads(size))
+        module = MixtralForCausalLM(cfg)
+    else:
+        raise KeyError(family)
+    ids = np.zeros((1, 8), dtype=np.int32)
+    abstract = compute_abstract_params(module, ids)
+    shapes = named_parameter_shapes(abstract)
+    sizes = [int(np.prod(s.shape)) for s in shapes.values()]
+    return sum(sizes), max(sizes)
+
+
+def _params_from_transformers(name_or_path: str) -> tuple[int, int]:
+    """Arbitrary Hub/local config via transformers meta-device init (config
+    arithmetic only — never downloads or materializes weights)."""
+    import torch
+    from transformers import AutoConfig, AutoModel
+
+    config = AutoConfig.from_pretrained(name_or_path)
+    with torch.device("meta"):
+        model = AutoModel.from_config(config)
+    sizes = [p.numel() for p in model.parameters()]
+    return sum(sizes), max(sizes) if sizes else 0
+
+
+def estimate_memory(model: str, dtypes: list[str]) -> list[dict]:
+    resolvers = []
+    if os.path.exists(model) and (model.endswith(".safetensors") or os.path.isdir(model)):
+        resolvers.append(_params_from_safetensors)
+    if ":" in model or model in ("llama", "mixtral"):
+        resolvers.append(_params_from_builtin)
+    resolvers.append(_params_from_transformers)
+
+    last_err = None
+    for resolver in resolvers:
+        try:
+            total, largest = resolver(model)
+            break
+        except Exception as e:  # fall through to the next resolver
+            last_err = e
+    else:
+        raise RuntimeError(f"Could not resolve model {model!r}: {last_err}")
+
+    rows = []
+    for dt in dtypes:
+        b = DTYPE_BYTES[dt]
+        params = int(total * b)
+        largest_layer = int(largest * b)
+        grads = params
+        # Adam: two fp32 moments + fp32 master copy when training in low precision.
+        master = int(total * 4) if dt != "fp32" else 0
+        optim = int(total * 4) * 2 + master
+        rows.append(
+            {
+                "dtype": dt,
+                "largest_layer": largest_layer,
+                "inference_total": params,
+                "training_total": params + grads + optim,
+            }
+        )
+    return rows
+
+
+def estimate_command(args: argparse.Namespace) -> int:
+    rows = estimate_memory(args.model_name, args.dtypes)
+    if args.json:
+        print(json.dumps(rows))
+        return 0
+    name = args.model_name
+    print(f"Memory estimate for `{name}` (weights never loaded):")
+    header = f"{'dtype':>6} | {'largest layer':>14} | {'inference':>12} | {'training (Adam)':>16}"
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(
+            f"{r['dtype']:>6} | {convert_bytes(r['largest_layer']):>14} | "
+            f"{convert_bytes(r['inference_total']):>12} | {convert_bytes(r['training_total']):>16}"
+        )
+    return 0
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser(
+        "estimate-memory", help="Estimate HBM needs for a model without loading weights"
+    )
+    p.add_argument(
+        "model_name",
+        help="Builtin spec ('llama:7b'), safetensors file/dir, or transformers model id/path",
+    )
+    p.add_argument("--dtypes", nargs="+", default=["fp32", "bf16", "fp8"], choices=list(DTYPE_BYTES))
+    p.add_argument("--json", action="store_true", help="Machine-readable output")
+    p.set_defaults(func=estimate_command)
+    return p

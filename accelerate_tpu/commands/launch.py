@@ -1,0 +1,186 @@
+"""`accelerate-tpu launch` — env encoding + process fan-out.
+
+Reference analog: commands/launch.py:986-1193 + utils/launch.py:100-427. The
+reference forks N CUDA workers per node via torchrun; a JAX/TPU pod instead
+runs ONE process per host, each seeing its local chips, rendezvousing through
+the JAX coordinator (state.py:_maybe_init_jax_distributed decodes the env this
+command writes). Fan-out modes:
+
+- single process: exec the script with the encoded env.
+- local multi-process (num_processes > 1, no remote hosts): spawn all
+  processes on this machine — the CI / `accelerate test` path; combined with
+  ``--virtual_devices`` this simulates a pod on CPU.
+- pod member (--machine_rank / TPU_POD): run this host's single process with
+  its process index; every pod worker runs the same command with its own rank
+  (the reference's tpu_pod_launcher role, driven by `tpu-config`-style ssh or
+  a cluster scheduler).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from .config_args import LaunchConfig, load_config_file
+
+
+def add_launch_args(p: argparse.ArgumentParser):
+    g = p.add_argument_group("launch configuration")
+    g.add_argument("--config_file", default=None, help="Config file created by `accelerate-tpu config`")
+    g.add_argument("--num_processes", type=int, default=None, help="Total JAX processes (1 per host)")
+    g.add_argument("--num_machines", type=int, default=None)
+    g.add_argument("--machine_rank", type=int, default=None, help="Index of this host (pod launch)")
+    g.add_argument("--main_process_ip", default=None, help="Coordinator (rank 0) address")
+    g.add_argument("--main_process_port", type=int, default=None)
+    g.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    g.add_argument("--cpu", action="store_true", help="Force JAX_PLATFORMS=cpu")
+    g.add_argument("--virtual_devices", type=int, default=None,
+                   help="Force N virtual CPU devices per process (pod simulation)")
+    g.add_argument("--debug", action="store_true", help="Enable collective shape verification")
+    g.add_argument("--gradient_accumulation_steps", type=int, default=None)
+
+    par = p.add_argument_group("parallelism degrees")
+    for ax in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        par.add_argument(f"--{ax}_size", type=int, default=None)
+
+    f = p.add_argument_group("FSDP / ZeRO")
+    f.add_argument("--use_fsdp", action="store_true", default=None)
+    f.add_argument("--fsdp_sharding_strategy", default=None,
+                   choices=["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"])
+    f.add_argument("--fsdp_offload_params", action="store_true", default=None)
+    f.add_argument("--fsdp_activation_checkpointing", action="store_true", default=None)
+
+    c = p.add_argument_group("compilation")
+    c.add_argument("--remat_policy", default=None, choices=["none", "full", "dots_saveable", "offload"])
+    c.add_argument("--no_scan_layers", action="store_true")
+    c.add_argument("--jit_cache_dir", default=None)
+
+    p.add_argument("-m", "--module", action="store_true", help="Treat the script as a python module")
+    p.add_argument("training_script", help="Script (or module with -m) to launch")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments")
+
+
+def resolve_launch_config(args: argparse.Namespace) -> LaunchConfig:
+    """Merge CLI flags over the config file (reference:
+    commands/launch.py:1196-1383 `_validate_launch_command`)."""
+    cfg = LaunchConfig.from_dict(load_config_file(args.config_file))
+    overrides = {
+        "num_processes": args.num_processes,
+        "num_machines": args.num_machines,
+        "machine_rank": args.machine_rank,
+        "main_process_ip": args.main_process_ip,
+        "main_process_port": args.main_process_port,
+        "mixed_precision": args.mixed_precision,
+        "virtual_devices": args.virtual_devices,
+        "gradient_accumulation_steps": args.gradient_accumulation_steps,
+        "fsdp_sharding_strategy": args.fsdp_sharding_strategy,
+        "remat_policy": args.remat_policy,
+        "jit_cache_dir": args.jit_cache_dir,
+        "use_fsdp": args.use_fsdp,
+        "fsdp_offload_params": args.fsdp_offload_params,
+        "fsdp_activation_checkpointing": args.fsdp_activation_checkpointing,
+    }
+    for ax in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        overrides[f"{ax}_size"] = getattr(args, f"{ax}_size")
+    for k, v in overrides.items():
+        if v is not None:
+            setattr(cfg, k, v)
+    if args.cpu:
+        cfg.use_cpu = True
+    if args.debug:
+        cfg.debug = True
+    if args.no_scan_layers:
+        cfg.scan_layers = False
+    if cfg.num_machines > 1 and cfg.num_processes < cfg.num_machines:
+        cfg.num_processes = cfg.num_machines
+    return cfg
+
+
+def _script_cmd(args: argparse.Namespace) -> list[str]:
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m"]
+    cmd += [args.training_script, *args.training_script_args]
+    return cmd
+
+
+def _spawn(cmd, env, rank: int | None = None) -> subprocess.Popen:
+    return subprocess.Popen(cmd, env=env)
+
+
+def launch_command(args: argparse.Namespace) -> int:
+    cfg = resolve_launch_config(args)
+    base_env = {**os.environ, **cfg.to_env()}
+    # Script-mode children resolve imports from the script's directory, not the
+    # launcher's cwd — propagate the cwd so repo-checkout runs work uninstalled.
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.environ.get("PYTHONPATH"), os.getcwd()) if p
+    )
+    cmd = _script_cmd(args)
+
+    if cfg.num_processes <= 1:
+        return subprocess.call(cmd, env=base_env)
+
+    coordinator_ip = cfg.main_process_ip or "127.0.0.1"
+    port = cfg.main_process_port
+    remote = cfg.main_process_ip not in (None, "", "127.0.0.1", "localhost") or cfg.num_machines > 1
+
+    if remote:
+        # This invocation is ONE pod member; its peers run the same command
+        # with their own --machine_rank.
+        env = {
+            **base_env,
+            "ACCELERATE_COORDINATOR_ADDRESS": f"{coordinator_ip}:{port or 8476}",
+            "ACCELERATE_NUM_PROCESSES": str(cfg.num_processes),
+            "ACCELERATE_PROCESS_INDEX": str(cfg.machine_rank),
+            "ACCELERATE_LOCAL_PROCESS_INDEX": "0",
+        }
+        return subprocess.call(cmd, env=env)
+
+    # Local fan-out: all processes on this machine.
+    if port is None:
+        from ..utils.other import get_free_port
+
+        port = get_free_port()
+    procs: list[subprocess.Popen] = []
+    try:
+        for rank in range(cfg.num_processes):
+            env = {
+                **base_env,
+                "ACCELERATE_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "ACCELERATE_NUM_PROCESSES": str(cfg.num_processes),
+                "ACCELERATE_PROCESS_INDEX": str(rank),
+                "ACCELERATE_LOCAL_PROCESS_INDEX": str(rank),
+            }
+            procs.append(_spawn(cmd, env, rank))
+        exit_code = 0
+        for rank, proc in enumerate(procs):
+            rc = proc.wait()
+            if rc != 0 and exit_code == 0:
+                exit_code = rc
+                print(
+                    f"[accelerate-tpu] process {rank} exited with code {rc}; "
+                    "terminating remaining processes",
+                    file=sys.stderr,
+                )
+                for other in procs:
+                    if other.poll() is None:
+                        other.send_signal(signal.SIGTERM)
+        return exit_code
+    except KeyboardInterrupt:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        for proc in procs:
+            proc.wait()
+        return 130
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("launch", help="Launch a training script on this host / pod member")
+    add_launch_args(p)
+    p.set_defaults(func=launch_command)
+    return p

@@ -1,0 +1,51 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint.
+
+Reference analog: commands/merge.py + utils/fsdp_utils.py:338-420
+(`merge_fsdp_weights`: torch DCP shards → one safetensors). Our `save_state`
+already writes name-keyed sharded safetensors (checkpointing.py); this command
+merges them into a single file (or re-shards at a different max size) so the
+result loads anywhere, including outside the framework.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ..utils.constants import MODEL_NAME
+from ..utils.other import load_sharded_safetensors, save_safetensors, save_sharded_safetensors
+
+
+def merge_command(args: argparse.Namespace) -> int:
+    in_dir = args.checkpoint_dir
+    weights_name = args.weights_name or f"{MODEL_NAME}.safetensors"
+    flat = load_sharded_safetensors(in_dir, weights_name=weights_name)
+    if not flat:
+        raise FileNotFoundError(f"No {weights_name} shards found in {in_dir}")
+    os.makedirs(args.output_dir, exist_ok=True)
+    out_name = args.output_name or weights_name
+    if args.max_shard_size:
+        save_sharded_safetensors(
+            flat, args.output_dir, weights_name=out_name, max_shard_size=args.max_shard_size
+        )
+    else:
+        save_safetensors(flat, os.path.join(args.output_dir, out_name))
+    n_params = sum(int(v.size) for v in flat.values())
+    print(
+        f"Merged {len(flat)} tensors ({n_params / 1e6:.1f}M params) from {in_dir} "
+        f"into {args.output_dir}/{out_name}"
+    )
+    return 0
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser(
+        "merge-weights", help="Merge a sharded safetensors checkpoint into one file"
+    )
+    p.add_argument("checkpoint_dir", help="Directory written by save_state/save_model")
+    p.add_argument("output_dir")
+    p.add_argument("--weights_name", default=None, help=f"Shard base name (default {MODEL_NAME}.safetensors)")
+    p.add_argument("--output_name", default=None)
+    p.add_argument("--max_shard_size", default=None, help="Re-shard at this size (e.g. 5GB) instead of one file")
+    p.set_defaults(func=merge_command)
+    return p

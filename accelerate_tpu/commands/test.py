@@ -1,0 +1,34 @@
+"""`accelerate-tpu test` — run the bundled sanity suite through the launcher
+(reference: commands/test.py)."""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+
+def test_command(args: argparse.Namespace) -> int:
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch"]
+    if args.config_file:
+        cmd.append(f"--config_file={args.config_file}")
+    if args.num_processes:
+        cmd.append(f"--num_processes={args.num_processes}")
+    if args.virtual_devices:
+        cmd += [f"--virtual_devices={args.virtual_devices}", "--cpu"]
+    cmd += ["-m", "accelerate_tpu.test_utils.scripts.test_script"]
+    print("Running:  " + " ".join(cmd))
+    rc = subprocess.call(cmd)
+    if rc == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return rc
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser("test", help="Run the bundled end-to-end sanity suite")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--virtual_devices", type=int, default=None,
+                   help="Simulate this many CPU devices per process")
+    p.set_defaults(func=test_command)
+    return p

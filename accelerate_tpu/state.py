@@ -125,6 +125,21 @@ class PartialState:
         self.fork_launched = parse_flag_from_env("FORK_LAUNCHED", False)
         if cpu:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # In launcher-spawned workers, make JAX_PLATFORMS win even when a site
+        # hook pre-registered another backend via jax.config (registration
+        # order would otherwise override the launcher's choice). Never applied
+        # in-process, where a user's explicit jax.config.update must stand.
+        launched = (
+            "ACCELERATE_COORDINATOR_ADDRESS" in os.environ
+            or "ACCELERATE_PROCESS_INDEX" in os.environ
+            or self.fork_launched
+        )
+        platforms = os.environ.get("JAX_PLATFORMS")
+        if platforms and (launched or cpu):
+            try:
+                jax.config.update("jax_platforms", platforms)
+            except Exception:
+                pass  # backend already initialized; keep what we have
         _maybe_init_jax_distributed()
 
         self.process_index = jax.process_index()

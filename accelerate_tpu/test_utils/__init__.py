@@ -1,0 +1,13 @@
+"""Test harness shipped with the wheel so `accelerate-tpu test` works
+post-install (reference: src/accelerate/test_utils/)."""
+
+from .testing import (
+    DEFAULT_LAUNCH_PORT,
+    assert_trees_equal,
+    execute_subprocess,
+    get_launch_command,
+    require_multi_device,
+    require_multi_process,
+    require_tpu,
+    skip,
+)

@@ -1,0 +1,43 @@
+"""Tiny synthetic fixtures (reference: test_utils/training.py —
+RegressionModel/RegressionDataset)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    """y = 2x + 1 with gaussian noise; map-style dict items."""
+
+    def __init__(self, length: int = 64, seed: int = 96):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (2.0 * self.x + 1.0 + 0.05 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def make_regression_model():
+    """Returns (flax module, loss_fn) for a scalar linear fit a*x + b."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class RegressionModel(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            a = self.param("a", lambda k: jnp.zeros(()))
+            b = self.param("b", lambda k: jnp.zeros(()))
+            return a * x + b
+
+    module = RegressionModel()
+
+    def loss_fn(params, batch):
+        pred = module.apply({"params": params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return module, loss_fn

@@ -173,9 +173,13 @@ def get_balanced_memory(
             for pat in no_split_modules
         )
     ]
-    buffer = max(leaves) if leaves else max(
-        (sizes[m] for m in sizes if m and "/" not in m), default=0
-    )
+    if not leaves:
+        # No no-split match: reserve the largest *leaf-parent* module (the
+        # deepest grouping that directly holds params — e.g. one transformer
+        # block), not a top-level module which is nearly the whole model.
+        _, (largest_leaf, _name) = calculate_maximum_sizes(abstract_params)
+        leaves = [largest_leaf]
+    buffer = max(leaves)
     target = per_device + buffer
     out = dict(max_memory)
     for d in devices:
@@ -246,10 +250,24 @@ def infer_auto_device_map(
     for k in sorted(abstract_params):
         _assign(k, abstract_params[k])
     # jax.Device placements instead of bare indices for device entries.
+    return normalize_device_map(device_map)
+
+
+def _covers(name: str, prefix: str, sep: str) -> bool:
+    """A device-map prefix covers a param; "" is the match-all root entry."""
+    return prefix == "" or name == prefix or name.startswith(prefix + sep)
+
+
+def normalize_device_map(device_map: Mapping[str, Any]) -> dict[str, Any]:
+    """Int placements → local jax devices (shared by dispatch/load paths)."""
     local = jax.local_devices()
-    return {
-        name: (local[p] if isinstance(p, int) else p) for name, p in device_map.items()
-    }
+    return {k: (local[v] if isinstance(v, int) else v) for k, v in device_map.items()}
+
+
+def default_execution_device(device_map: Mapping[str, Any]):
+    """First real device in the map, else the first local device."""
+    devs = [d for d in device_map.values() if not isinstance(d, str)]
+    return devs[0] if devs else jax.local_devices()[0]
 
 
 def check_device_map(abstract_params, device_map: Mapping[str, Placement], sep: str = "/"):
@@ -257,7 +275,7 @@ def check_device_map(abstract_params, device_map: Mapping[str, Placement], sep: 
     (reference: utils/modeling.py:1604-1639)."""
     names = list(named_parameter_shapes(abstract_params, sep=sep))
     for n in names:
-        hits = [p for p in device_map if n == p or n.startswith(p + sep)]
+        hits = [p for p in device_map if _covers(n, p, sep)]
         if len(hits) == 0:
             raise ValueError(f"Param {n!r} not covered by device_map")
         if len(hits) > 1:
@@ -265,7 +283,7 @@ def check_device_map(abstract_params, device_map: Mapping[str, Placement], sep: 
             # non-nested prefixes is a config error.
             hits.sort(key=len)
             for a, b in zip(hits, hits[1:]):
-                if not b.startswith(a + sep) and a != b:
+                if a != "" and not b.startswith(a + sep) and a != b:
                     raise ValueError(f"Param {n!r} covered by overlapping entries {hits}")
 
 
@@ -273,7 +291,7 @@ def placement_for(name: str, device_map: Mapping[str, Placement], sep: str = "/"
     """Longest-prefix lookup of a param's placement."""
     best, best_len = None, -1
     for prefix, placement in device_map.items():
-        if (name == prefix or name.startswith(prefix + sep)) and len(prefix) > best_len:
+        if _covers(name, prefix, sep) and len(prefix) > best_len:
             best, best_len = placement, len(prefix)
     if best is None:
         raise KeyError(f"No device_map entry covers {name!r}")

@@ -155,3 +155,42 @@ def test_get_max_memory_budget_keys():
     assert "cpu" in mm and 0 in mm
     mm2 = get_max_memory({0: "1GiB", "cpu": 123})
     assert mm2[0] == 1024**3 and mm2["cpu"] == 123
+
+
+def test_load_checkpoint_and_dispatch_device_map_none(tmp_path):
+    """Root "" device-map entry covers every param (review regression)."""
+    cfg, module, ids = _tiny_llama()
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    expected = np.asarray(model(ids))
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    flat = {k: np.asarray(v) for k, v in flatten_state_dict(model.params).items()}
+    save_sharded_safetensors(flat, ckpt)
+    off = load_checkpoint_and_dispatch(module, ckpt, ids, device_map=None)
+    np.testing.assert_allclose(np.asarray(off(ids)), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_balanced_memory_spreads_layers():
+    """balanced budgets must be tighter than raw caps so layers spread
+    (review regression: fallback buffer was ~the whole model)."""
+    from accelerate_tpu.utils.modeling import get_balanced_memory
+
+    cfg, module, ids = _tiny_llama()
+    abstract = init_empty_weights(module, ids)
+    sizes = compute_module_sizes(abstract)
+    raw = {0: sizes[""], 1: sizes[""]}  # each device could hold everything
+    balanced = get_balanced_memory(abstract, dict(raw))
+    assert balanced[0] < raw[0], "balanced budget should cap below the full model"
+    dm = infer_auto_device_map(abstract, balanced)
+    used_devices = {v for v in dm.values() if not isinstance(v, str)}
+    assert len(used_devices) >= 2 or len(jax.local_devices()) < 2
+
+
+def test_notebook_launcher_refuses_live_backend():
+    import pytest as _pytest
+
+    from accelerate_tpu import notebook_launcher
+
+    jax.devices()  # ensure the backend is up in this process
+    with _pytest.raises(RuntimeError, match="already initialized"):
+        notebook_launcher(lambda: None, num_processes=2)
