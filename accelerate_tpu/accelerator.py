@@ -54,6 +54,7 @@ from .train_state import DynamicLossScale, TrainState, grads_all_finite
 from .utils import (
     DataLoaderConfiguration,
     DistributedOperationException,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     GradScalerKwargs,
@@ -138,11 +139,14 @@ class Accelerator:
         # kwargs handlers (reference: accelerator.py:415-452)
         self.scaler_handler = None
         self.profile_handler = None
+        self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
             elif isinstance(handler, ProfileKwargs):
                 self.profile_handler = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -243,6 +247,18 @@ class Accelerator:
         return self.state.mixed_precision
 
     @property
+    def fp8_dot_general(self):
+        """Recipe-configured fp8 dot_general for custom modules (None unless
+        mixed_precision="fp8"); model configs with an ``fp8`` flag wire this
+        in automatically (ops/fp8.py)."""
+        if self.state.mixed_precision != "fp8":
+            return None
+        from .ops.fp8 import fp8_dot_general
+
+        fmt = self.fp8_recipe_handler.fp8_format if self.fp8_recipe_handler else "HYBRID"
+        return fp8_dot_general(fmt)
+
+    @property
     def gradient_accumulation_steps(self) -> int:
         return self.gradient_state.num_steps
 
@@ -269,6 +285,10 @@ class Accelerator:
     @property
     def train_state(self) -> Optional[TrainState]:
         return self._train_state
+
+    @train_state.setter
+    def train_state(self, value: TrainState):
+        self._train_state = value
 
     @property
     def state_shardings(self):
@@ -751,7 +771,16 @@ class Accelerator:
                 return new_state, {"loss": loss, "grad_norm": gnorm}
 
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
-        return jitted
+
+        def step_and_track(state: TrainState, batch):
+            new_state, metrics = jitted(state, batch)
+            # Keep the accelerator's view current: with buffer donation the
+            # previous state's arrays are dead after this call, so save_state,
+            # Model.__call__ and trackers must see the new one.
+            self._train_state = new_state
+            return new_state, metrics
+
+        return step_and_track
 
     # ------------------------------------------------------------------
     # Metrics & collectives surface (reference: accelerator.py:3000-3270)

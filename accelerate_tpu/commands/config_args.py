@@ -92,7 +92,8 @@ class LaunchConfig:
 
     def save(self, path: Optional[str] = None) -> str:
         path = path or default_config_file()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
         payload = self.to_dict()
         with open(path, "w") as f:
             if path.endswith((".yaml", ".yml")):

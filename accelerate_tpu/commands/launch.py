@@ -156,19 +156,28 @@ def launch_command(args: argparse.Namespace) -> int:
                 "ACCELERATE_LOCAL_PROCESS_INDEX": str(rank),
             }
             procs.append(_spawn(cmd, env, rank))
+        # Fail fast on ANY rank's crash (not just rank 0's): poll all children
+        # so a dead peer doesn't leave siblings blocked in coordinator
+        # rendezvous until their own timeout.
+        import time
+
         exit_code = 0
-        for rank, proc in enumerate(procs):
-            rc = proc.wait()
-            if rc != 0 and exit_code == 0:
-                exit_code = rc
-                print(
-                    f"[accelerate-tpu] process {rank} exited with code {rc}; "
-                    "terminating remaining processes",
-                    file=sys.stderr,
-                )
-                for other in procs:
-                    if other.poll() is None:
-                        other.send_signal(signal.SIGTERM)
+        while any(p.poll() is None for p in procs):
+            for rank, proc in enumerate(procs):
+                rc = proc.poll()
+                if rc is not None and rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    print(
+                        f"[accelerate-tpu] process {rank} exited with code {rc}; "
+                        "terminating remaining processes",
+                        file=sys.stderr,
+                    )
+                    for other in procs:
+                        if other.poll() is None:
+                            other.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+        if exit_code == 0:
+            exit_code = next((p.returncode for p in procs if p.returncode != 0), 0)
         return exit_code
     except KeyboardInterrupt:
         for proc in procs:

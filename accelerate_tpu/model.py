@@ -104,15 +104,26 @@ class Model:
     # -- forward ---------------------------------------------------------
 
     def __call__(self, *args, rngs=None, train: bool = False, **kwargs):
-        variables = {"params": self.params}
+        params = self.params
         extra = self.extra_state
         if self._accelerator is not None and self._accelerator._train_state is not None:
+            # Live view: after jitted steps (which donate the old buffers) the
+            # accelerator's train state holds the current params.
+            params = self._accelerator._train_state.params
             extra = self._accelerator._train_state.extra_state
+        variables = {"params": params}
         if extra:
             variables.update(extra)
         call_kwargs = dict(kwargs)
         if rngs is not None:
             call_kwargs["rngs"] = rngs
+        if not train:
+            # Inference: fp8 recipes with use_during_eval=False (the default)
+            # trace their matmuls in full precision (ops/fp8.py eval_mode).
+            from .ops.fp8 import eval_mode
+
+            with eval_mode():
+                return self.apply_fn(variables, *args, **call_kwargs)
         return self.apply_fn(variables, *args, **call_kwargs)
 
     def eval(self):

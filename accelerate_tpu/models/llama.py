@@ -49,10 +49,23 @@ class LlamaConfig:
     scan_layers: bool = True
     remat: bool = False
     attention_impl: str = "native"      # native | flash | ring | ulysses
+    fp8: bool = False                   # fp8 (QDQ) matmuls in MLP/attention projections
+    fp8_format: str = "HYBRID"          # E4M3 | E5M2 | HYBRID (e4m3 fwd / e5m2 bwd)
 
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_attention_heads
+
+    @property
+    def dot_general(self):
+        """dot_general injected into every projection: fp8 QDQ when enabled
+        (ops/fp8.py — the reference's TE/AO fp8 linear swap role), else the
+        XLA default."""
+        if not self.fp8:
+            return None
+        from ..ops.fp8 import fp8_dot_general
+
+        return fp8_dot_general(self.fp8_format)
 
     @classmethod
     def tiny(cls, **kw):
@@ -154,7 +167,10 @@ class LlamaAttention(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         d = cfg.head_dim
-        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        dense = partial(
+            nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )
         q = dense(features=(cfg.num_attention_heads, d), name="q_proj")(x)
         k = dense(features=(cfg.num_key_value_heads, d), name="k_proj")(x)
         v = dense(features=(cfg.num_key_value_heads, d), name="v_proj")(x)
@@ -166,6 +182,7 @@ class LlamaAttention(nn.Module):
         return nn.DenseGeneral(
             features=x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name="o_proj",
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
         )(out)
 
 
@@ -175,7 +192,10 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        dense = partial(
+            nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )
         gate = dense(cfg.intermediate_size, name="gate_proj")(x)
         up = dense(cfg.intermediate_size, name="up_proj")(x)
         return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)

@@ -1,1 +1,10 @@
 from .flash_attention import blockwise_attention, flash_attention
+from .fp8 import (
+    dequantize_params_fp8,
+    fp8_dot_general,
+    fp8_einsum,
+    qdq_e4m3,
+    qdq_e5m2,
+    qdq_hybrid,
+    quantize_params_fp8,
+)

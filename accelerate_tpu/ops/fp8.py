@@ -1,0 +1,170 @@
+"""fp8 matmul path (TPU-native re-design of the reference's fp8 backends:
+utils/transformer_engine.py:26-186, utils/ao.py:104-143, recipe kwargs
+utils/dataclasses.py:312-484).
+
+Mechanism: quantize-dequantize (QDQ) in ``float8_e4m3fn`` around the dot with
+per-tensor dynamic ("current") scaling — the standard XLA fp8 pattern, which
+the compiler's fp8 rewriter fuses into a scaled fp8 matmul on hardware with
+fp8 MXU paths and lowers to bf16 compute elsewhere, so the same program is
+correct on every TPU generation. The HYBRID recipe (E4M3 forward / E5M2
+backward, matching the reference's TE default) is expressed with a
+``custom_vjp``: the backward cotangent is QDQ'd to ``float8_e5m2`` (wider
+range for gradients), then autodiff transposes the dot as usual.
+
+Usage: pass ``fp8_dot_general(recipe)`` as the ``dot_general`` argument of
+``nn.Dense`` / ``nn.DenseGeneral`` (model configs expose an ``fp8`` flag that
+does this), or call ``qdq_e4m3`` / ``qdq_hybrid`` directly in custom layers.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+E4M3_MAX = 448.0        # float8_e4m3fn finite max
+E5M2_MAX = 57344.0      # float8_e5m2 finite max
+
+_EVAL_MODE = threading.local()
+
+
+@contextmanager
+def eval_mode(active: bool = True):
+    """Trace-time flag: inside this context, fp8 dot_generals built with
+    ``use_during_eval=False`` (the recipe default, matching the reference's
+    ``FP8RecipeKwargs.use_during_eval``) fall back to full precision.
+    ``Model.__call__(train=False)`` enters it automatically."""
+    prev = getattr(_EVAL_MODE, "active", False)
+    _EVAL_MODE.active = active
+    try:
+        yield
+    finally:
+        _EVAL_MODE.active = prev
+
+
+def in_eval_mode() -> bool:
+    return getattr(_EVAL_MODE, "active", False)
+
+
+def _qdq(x: jax.Array, fp8_dtype, fp8_max: float) -> jax.Array:
+    """Quantize to fp8 with a per-tensor dynamic scale, dequantize back.
+
+    The scale maps the tensor's amax onto the fp8 dtype's max, so the full
+    dynamic range of the format is used every call (torchao "dynamic scaling";
+    the reference's delayed-scaling amax history is a latency optimization for
+    GPUs — with XLA the scale compute fuses into the producer, so current
+    scaling is both simpler and exact).
+    """
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    scale = jnp.where(amax > 0, amax / fp8_max, 1.0)
+    q = (x.astype(jnp.float32) / scale).astype(fp8_dtype)
+    return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def qdq_e4m3(x: jax.Array) -> jax.Array:
+    return _qdq(x, jnp.float8_e4m3fn, E4M3_MAX)
+
+
+def qdq_e5m2(x: jax.Array) -> jax.Array:
+    return _qdq(x, jnp.float8_e5m2, E5M2_MAX)
+
+
+@jax.custom_vjp
+def qdq_hybrid(x: jax.Array) -> jax.Array:
+    """E4M3 on the forward value, E5M2 on the backward cotangent
+    (the reference's HYBRID format, utils/dataclasses.py FP8RecipeKwargs)."""
+    return qdq_e4m3(x)
+
+
+def _qdq_hybrid_fwd(x):
+    return qdq_e4m3(x), None
+
+
+def _qdq_hybrid_bwd(_, g):
+    return (qdq_e5m2(g),)
+
+
+qdq_hybrid.defvjp(_qdq_hybrid_fwd, _qdq_hybrid_bwd)
+
+
+def fp8_dot_general(fp8_format: str = "HYBRID", use_during_eval: bool = False):
+    """Returns a drop-in ``lax.dot_general`` replacement quantizing both
+    operands to fp8. Plug into ``nn.Dense(dot_general=...)``.
+
+    fp8_format: "E4M3" (fwd+bwd in e4m3), "E5M2" (everything e5m2, rarely
+    useful), or "HYBRID" (e4m3 fwd / e5m2 bwd — the default recipe).
+    use_during_eval=False (recipe default) keeps full precision when tracing
+    inside :func:`eval_mode`.
+    """
+    fmt = fp8_format.upper()
+    if fmt == "HYBRID":
+        q = qdq_hybrid
+    elif fmt == "E4M3":
+        q = qdq_e4m3
+    elif fmt == "E5M2":
+        q = qdq_e5m2
+    else:
+        raise ValueError(f"fp8_format must be E4M3|E5M2|HYBRID, got {fp8_format}")
+
+    def dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type: Optional[jnp.dtype] = None):
+        if not use_during_eval and in_eval_mode():
+            return lax.dot_general(
+                lhs, rhs, dimension_numbers,
+                precision=precision, preferred_element_type=preferred_element_type,
+            )
+        return lax.dot_general(
+            q(lhs), q(rhs), dimension_numbers,
+            precision=precision, preferred_element_type=preferred_element_type,
+        )
+
+    return dot_general
+
+
+def fp8_einsum(fp8_format: str = "HYBRID"):
+    """``jnp.einsum`` with fp8-quantized operands (for attention projections
+    written as einsums)."""
+    fmt = fp8_format
+
+    def einsum(subscripts, *operands, **kwargs):
+        dg = fp8_dot_general(fmt)
+        return jnp.einsum(
+            subscripts, *operands, _dot_general=dg, **kwargs
+        )
+
+    return einsum
+
+
+def quantize_params_fp8(params, fp8_dtype=None):
+    """Storage-side quantization: cast float params to fp8 with per-tensor
+    scales (the reference's layerwise-upcast hook role, hooks.py:784-810).
+    Returns (quantized_tree, scales_tree); dequantize with
+    :func:`dequantize_params_fp8`."""
+    fp8_dtype = fp8_dtype or jnp.float8_e4m3fn
+    fp8_max = E4M3_MAX if fp8_dtype == jnp.float8_e4m3fn else E5M2_MAX
+
+    def _q(x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
+            return x, None
+        amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        scale = jnp.where(amax > 0, amax / fp8_max, 1.0)
+        return (x.astype(jnp.float32) / scale).astype(fp8_dtype), scale
+
+    flat = jax.tree.map(_q, params)
+    q_tree = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+    s_tree = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+    return q_tree, s_tree
+
+
+def dequantize_params_fp8(q_tree, s_tree, dtype=jnp.bfloat16):
+    def _dq(q, s):
+        if s is None:
+            return q
+        return (q.astype(jnp.float32) * s).astype(dtype)
+
+    return jax.tree.map(_dq, q_tree, s_tree, is_leaf=lambda x: x is None)

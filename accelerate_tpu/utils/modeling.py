@@ -177,8 +177,12 @@ def get_balanced_memory(
         # No no-split match: reserve the largest *leaf-parent* module (the
         # deepest grouping that directly holds params — e.g. one transformer
         # block), not a top-level module which is nearly the whole model.
-        _, (largest_leaf, _name) = calculate_maximum_sizes(abstract_params)
-        leaves = [largest_leaf]
+        # Uses `sizes` so the ``dtype`` override applies here too.
+        leaf_parents = {
+            "/".join(n.split("/")[:-1]) or n
+            for n in named_parameter_shapes(abstract_params)
+        }
+        leaves = [sizes.get(p, 0) for p in leaf_parents]
     buffer = max(leaves)
     target = per_device + buffer
     out = dict(max_memory)

@@ -1,0 +1,198 @@
+"""fp8 QDQ matmul path (ops/fp8.py) — numerics, gradients, model integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_qdq_e4m3_roundtrip_error():
+    from accelerate_tpu.ops import qdq_e4m3
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32))
+    y = qdq_e4m3(x)
+    # e4m3 has ~2 mantissa-bit relative precision after per-tensor scaling.
+    rel = float(jnp.max(jnp.abs(y - x)) / jnp.max(jnp.abs(x)))
+    assert rel < 0.1, rel
+    # Scale adapts: a tensor with large magnitude round-trips equally well.
+    x2 = x * 1e4
+    y2 = qdq_e4m3(x2)
+    rel2 = float(jnp.max(jnp.abs(y2 - x2)) / jnp.max(jnp.abs(x2)))
+    assert rel2 < 0.1, rel2
+
+
+def test_qdq_zero_tensor():
+    from accelerate_tpu.ops import qdq_e4m3
+
+    z = jnp.zeros((8, 8))
+    np.testing.assert_array_equal(np.asarray(qdq_e4m3(z)), 0.0)
+
+
+def test_fp8_dot_general_forward_close_to_fp32():
+    from accelerate_tpu.ops import fp8_dot_general
+
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    dn = (((1,), (0,)), ((), ()))
+    exact = jax.lax.dot_general(a, b, dn)
+    dg = fp8_dot_general("HYBRID")
+    got = dg(a, b, dn)
+    # fp8 matmul tolerance: per-element relative to the output scale.
+    err = float(jnp.max(jnp.abs(got - exact)) / jnp.max(jnp.abs(exact)))
+    assert err < 0.15, err
+
+
+def test_fp8_dot_general_gradients_flow():
+    from accelerate_tpu.ops import fp8_dot_general
+
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+    dn = (((1,), (0,)), ((), ()))
+    dg = fp8_dot_general("HYBRID")
+
+    def f(a, b):
+        return jnp.sum(dg(a, b, dn) ** 2)
+
+    ga, gb = jax.grad(f, argnums=(0, 1))(a, b)
+    ga_ref, gb_ref = jax.grad(lambda a, b: jnp.sum(jax.lax.dot_general(a, b, dn) ** 2),
+                              argnums=(0, 1))(a, b)
+    assert np.all(np.isfinite(ga)) and np.all(np.isfinite(gb))
+    # e5m2 backward: coarser, but must track the true gradient direction.
+    cos = float(jnp.sum(ga * ga_ref) / (jnp.linalg.norm(ga) * jnp.linalg.norm(ga_ref)))
+    assert cos > 0.98, cos
+
+
+def test_quantize_params_roundtrip():
+    from accelerate_tpu.ops import dequantize_params_fp8, quantize_params_fp8
+
+    rng = np.random.default_rng(3)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32)),
+        "step": jnp.asarray(3, jnp.int32),  # non-float leaves pass through
+    }
+    q, s = quantize_params_fp8(params)
+    assert q["w"].dtype == jnp.float8_e4m3fn
+    assert q["step"].dtype == jnp.int32
+    back = dequantize_params_fp8(q, s, dtype=jnp.float32)
+    rel = float(jnp.max(jnp.abs(back["w"] - params["w"])) / jnp.max(jnp.abs(params["w"])))
+    assert rel < 0.1
+    assert int(back["step"]) == 3
+
+
+def test_llama_fp8_trains_close_to_bf16():
+    """A tiny Llama with fp8 projections: losses finite and within a few % of
+    the bf16 run after a few steps."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(4, 33), dtype=np.int32)
+
+    def run(fp8: bool):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(0)
+        cfg = LlamaConfig.tiny(dtype=jnp.bfloat16, fp8=fp8)
+        module = LlamaForCausalLM(cfg)
+        acc = Accelerator(mixed_precision="fp8" if fp8 else "bf16")
+        model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+        model, _ = acc.prepare(model, optax.adam(1e-3))
+
+        def loss_fn(params, batch):
+            logits = module.apply({"params": params}, batch["x"])
+            return cross_entropy_loss(logits, batch["y"])
+
+        step = acc.prepare_train_step(loss_fn)
+        state = acc.train_state
+        batch = {"x": ids[:, :-1], "y": ids[:, 1:]}
+        losses = []
+        for _ in range(5):
+            state, m = step(state, batch)
+            losses.append(float(np.asarray(m["loss"])))
+        return losses
+
+    l_fp8 = run(True)
+    l_bf16 = run(False)
+    assert all(np.isfinite(l_fp8)), l_fp8
+    assert l_fp8[-1] < l_fp8[0], "fp8 run did not descend"
+    np.testing.assert_allclose(l_fp8[0], l_bf16[0], rtol=0.05)
+
+
+def test_fp16_dynamic_loss_scale_updates():
+    """Unit semantics of DynamicLossScale: growth after interval, backoff on
+    overflow (reference GradScaler behavior, accelerator.py:577-583)."""
+    from accelerate_tpu.train_state import DynamicLossScale
+
+    ls = DynamicLossScale.create(init_scale=1024.0, growth_interval=2)
+    ls = ls.update(jnp.asarray(True))
+    assert float(ls.scale) == 1024.0 and int(ls.growth_tracker) == 1
+    ls = ls.update(jnp.asarray(True))  # hits interval → grow
+    assert float(ls.scale) == 2048.0 and int(ls.growth_tracker) == 0
+    ls = ls.update(jnp.asarray(False))  # overflow → backoff
+    assert float(ls.scale) == 1024.0
+
+
+def test_fp16_training_skips_overflow_steps():
+    """fp16 train step: params unchanged on an overflowing microbatch, scale
+    backs off; normal batches still descend."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils.training import make_regression_model
+    from accelerate_tpu.utils import set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    set_seed(0)
+    module, loss_fn = make_regression_model()
+    acc = Accelerator(mixed_precision="fp16")
+    model = Model.from_flax(module, jax.random.key(0), np.zeros((8,), np.float32))
+    model, _ = acc.prepare(model, optax.sgd(0.05))
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    assert state.loss_scale is not None
+    scale0 = float(np.asarray(state.loss_scale.scale))
+
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    good = {"x": x, "y": (2 * x + 1).astype(np.float32)}
+    state, m = step(state, good)
+    params_before = jax.tree.map(np.asarray, state.params)
+
+    bad = {"x": x, "y": np.full((8,), np.inf, np.float32)}  # non-finite grads
+    state, m = step(state, bad)
+    params_after = jax.tree.map(np.asarray, state.params)
+    # Overflow step: params must be untouched, scale must back off.
+    np.testing.assert_array_equal(params_after["a"], params_before["a"])
+    assert float(np.asarray(state.loss_scale.scale)) < scale0 * 1.01
+
+    for _ in range(10):
+        state, m = step(state, good)
+    assert float(np.asarray(m["loss"])) < 1.0
+
+
+def test_fp8_eval_mode_full_precision():
+    """use_during_eval=False (default): inside eval_mode the fp8 dot is exact
+    (review regression: the flag was silently ignored)."""
+    from accelerate_tpu.ops import fp8_dot_general
+    from accelerate_tpu.ops.fp8 import eval_mode
+
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+    dn = (((1,), (0,)), ((), ()))
+    exact = jax.lax.dot_general(a, b, dn)
+    dg = fp8_dot_general("HYBRID", use_during_eval=False)
+    with eval_mode():
+        np.testing.assert_array_equal(np.asarray(dg(a, b, dn)), np.asarray(exact))
+    assert float(jnp.max(jnp.abs(dg(a, b, dn) - exact))) > 0  # quantized outside
+    always = fp8_dot_general("HYBRID", use_during_eval=True)
+    with eval_mode():
+        assert float(jnp.max(jnp.abs(always(a, b, dn) - exact))) > 0
