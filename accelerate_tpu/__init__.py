@@ -41,6 +41,7 @@ from .optimizer import AcceleratedOptimizer  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .train_state import TrainState  # noqa: E402
 from .launchers import debug_launcher, notebook_launcher  # noqa: E402
+from .local_sgd import LocalSGD  # noqa: E402
 from .big_modeling import (  # noqa: E402
     DispatchedModel,
     cpu_offload,

@@ -255,8 +255,14 @@ class Accelerator:
             return None
         from .ops.fp8 import fp8_dot_general
 
-        fmt = self.fp8_recipe_handler.fp8_format if self.fp8_recipe_handler else "HYBRID"
-        return fp8_dot_general(fmt)
+        # amax_history_len / amax_compute_algo are delayed-scaling knobs the
+        # reference needs on GPU; current scaling fuses into the producer under
+        # XLA, so only format and eval policy carry over (ops/fp8.py).
+        recipe = self.fp8_recipe_handler
+        return fp8_dot_general(
+            recipe.fp8_format if recipe else "HYBRID",
+            use_during_eval=recipe.use_during_eval if recipe else False,
+        )
 
     @property
     def gradient_accumulation_steps(self) -> int:

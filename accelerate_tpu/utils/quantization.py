@@ -1,0 +1,248 @@
+"""int8 / int4 weight-only quantization (TPU-native re-design of the
+reference's bitsandbytes integration: utils/bnb.py:44-473,
+``BnbQuantizationConfig`` utils/dataclasses.py:3055).
+
+bitsandbytes ships CUDA kernels; on TPU the same capability is expressed as
+data layout + XLA ops:
+
+- **int8**: per-output-channel symmetric scales (absmax/127). The MXU has a
+  native int8 path, and the dequant (``q * s``) fuses into the consumer matmul.
+- **int4**: linear 4-bit with *grouped* scales (``group_size`` input elements
+  share one scale — the bnb blockwise idea) packed two nibbles per uint8, so
+  storage is shape[..., K/2] bytes + fp16 scales.
+
+Quantized leaves live in the params tree as :class:`QuantizedTensor` pytrees;
+``load_and_quantize_model`` returns a ``Model`` whose forward dequantizes
+inline under jit — XLA schedules the bf16 copies transiently (with scanned
+layers, one block at a time), so HBM at rest holds only the packed weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """(reference: BnbQuantizationConfig, utils/dataclasses.py:3055-3180)"""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    group_size: int = 64                      # int4 scale granularity (bnb blocksize)
+    compute_dtype: Any = jnp.bfloat16         # dequantized matmul dtype
+    skip_modules: Optional[list[str]] = None  # name regexes kept full precision
+    keep_in_fp32_modules: Optional[list[str]] = None
+    min_size_to_quantize: int = 2**12         # small tensors are not worth packing
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("load_in_8bit and load_in_4bit are mutually exclusive")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("Set load_in_8bit=True or load_in_4bit=True")
+        if self.group_size % 2 != 0:
+            raise ValueError("group_size must be even (two int4 per byte)")
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+
+BnbQuantizationConfig = QuantizationConfig  # migration alias
+
+# NF4 codebook (QLoRA): the 16 quantiles of N(0,1) normalized to [-1, 1] —
+# information-theoretically optimal 4-bit levels for gaussian weights.
+NF4_CODE = np.asarray(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    dtype=np.float32,
+)
+# Decision boundaries = midpoints between adjacent levels (for searchsorted).
+NF4_BOUNDARIES = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0
+
+
+@struct.dataclass
+class QuantizedTensor:
+    """A quantized weight leaf: packed data + scales + static metadata."""
+
+    data: jax.Array                      # int8 (8-bit) or uint8 nibble-packed (4-bit)
+    scales: jax.Array                    # fp32; per-channel (8b) or per-group (4b)
+    shape: tuple = struct.field(pytree_node=False)
+    bits: int = struct.field(pytree_node=False)
+    group_size: int = struct.field(pytree_node=False, default=64)
+
+    @property
+    def nbytes_packed(self) -> int:
+        return int(np.prod(self.data.shape)) * self.data.dtype.itemsize + self.scales.nbytes
+
+
+def quantize_tensor_int8(w: jax.Array) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 (last dim = output features, the
+    Dense kernel layout (in, out))."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(range(w32.ndim - 1)), keepdims=True)
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scales), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(data=q, scales=scales, shape=tuple(w.shape), bits=8)
+
+
+def quantize_tensor_int4(w: jax.Array, group_size: int = 64) -> QuantizedTensor:
+    """NF4: per-group absmax normalization + nearest-NF4-level index, packed
+    two 4-bit indices per uint8 byte. Groups run along the flattened leading
+    (input, incl. stacked-layer) dims."""
+    shape = tuple(w.shape)
+    w2 = jnp.asarray(w, jnp.float32).reshape(-1, shape[-1])  # (lead_flat, out)
+    k, n = w2.shape
+    pad = (-k) % group_size
+    if pad:
+        w2 = jnp.concatenate([w2, jnp.zeros((pad, n), jnp.float32)], axis=0)
+    g = w2.shape[0] // group_size
+    grouped = w2.reshape(g, group_size, n)
+    amax = jnp.max(jnp.abs(grouped), axis=1, keepdims=True)
+    scales = jnp.where(amax > 0, amax, 1.0)                    # (g, 1, n)
+    normalized = grouped / scales                              # in [-1, 1]
+    idx = jnp.searchsorted(jnp.asarray(NF4_BOUNDARIES), normalized).astype(jnp.uint8)
+    idx = idx.reshape(-1, n)                                   # (k+pad, n), even rows
+    packed = (idx[1::2] << 4) | idx[0::2]                      # ((k+pad)/2, n)
+    return QuantizedTensor(
+        data=packed, scales=scales[:, 0, :], shape=shape, bits=4, group_size=group_size
+    )
+
+
+def _unpack_int4(packed: jax.Array) -> jax.Array:
+    """uint8 bytes → NF4 indices in [0, 15], interleaved back to rows."""
+    lo = (packed & 0xF).astype(jnp.uint8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.uint8)
+    rows = jnp.stack([lo, hi], axis=1)                         # (k/2, 2, n)
+    return rows.reshape(-1, packed.shape[-1])                  # (k, n)
+
+
+def dequantize_tensor(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    if qt.bits == 8:
+        return (qt.data.astype(jnp.float32) * qt.scales).astype(dtype).reshape(qt.shape)
+    k = int(np.prod(qt.shape[:-1]))
+    n = qt.shape[-1]
+    idx = _unpack_int4(qt.data)                                # (k+pad, n)
+    vals = jnp.asarray(NF4_CODE)[idx]                          # codebook lookup
+    g = vals.shape[0] // qt.group_size
+    grouped = vals.reshape(g, qt.group_size, n)
+    w = grouped * qt.scales[:, None, :]
+    return w.reshape(-1, n)[:k].reshape(qt.shape).astype(dtype)
+
+
+def is_quantized(leaf) -> bool:
+    return isinstance(leaf, QuantizedTensor)
+
+
+def quantize_params(params, config: QuantizationConfig, sep: str = "/"):
+    """Quantize eligible float leaves of a params pytree; returns the mixed
+    tree (QuantizedTensor leaves + untouched small/skipped tensors).
+
+    Eligibility mirrors bnb's module filter (utils/bnb.py:117-177): ≥2-D float
+    tensors above ``min_size_to_quantize`` whose path matches no skip regex.
+    1-D tensors (norms, biases) always stay full precision.
+    """
+    skip = [re.compile(p) for p in (config.skip_modules or [])]
+    fp32_keep = [re.compile(p) for p in (config.keep_in_fp32_modules or [])]
+
+    def _walk(prefix, node):
+        if isinstance(node, dict):
+            return {k: _walk(f"{prefix}{sep}{k}" if prefix else k, v) for k, v in node.items()}
+        x = node
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
+            return x
+        if any(r.search(prefix) for r in fp32_keep):
+            return jnp.asarray(x, jnp.float32)
+        if (
+            x.ndim < 2
+            or int(np.prod(x.shape)) < config.min_size_to_quantize
+            or any(r.search(prefix) for r in skip)
+        ):
+            return x
+        if config.bits == 8:
+            return quantize_tensor_int8(x)
+        return quantize_tensor_int4(x, config.group_size)
+
+    return _walk("", params)
+
+
+def dequantize_params(params, dtype=jnp.bfloat16):
+    """Inline dequantization of a mixed tree (call inside jit: XLA fuses the
+    ``q * s`` into consumers and frees the bf16 copies after use)."""
+    return jax.tree.map(
+        lambda x: dequantize_tensor(x, dtype) if is_quantized(x) else x,
+        params,
+        is_leaf=is_quantized,
+    )
+
+
+def quantized_nbytes(params) -> int:
+    """HBM-at-rest footprint of a mixed tree."""
+    total = 0
+    for leaf in jax.tree.leaves(params, is_leaf=is_quantized):
+        if is_quantized(leaf):
+            total += leaf.nbytes_packed
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total
+
+
+def load_and_quantize_model(
+    model,
+    quantization_config: QuantizationConfig,
+):
+    """Quantize a loaded :class:`~accelerate_tpu.model.Model` in place for
+    inference (reference: utils/bnb.py:44-116 ``load_and_quantize_model``).
+
+    The returned model's forward dequantizes under jit to
+    ``config.compute_dtype``. When ``skip_modules`` is unset, embeddings and
+    the LM head stay full precision — bnb converts only ``nn.Linear`` modules
+    (reference: utils/bnb.py:117-177, default ``modules_to_not_convert``
+    includes the output head), and those two dominate quantization error.
+    """
+    from ..model import Model
+
+    if quantization_config.skip_modules is None:
+        quantization_config = dataclasses.replace(
+            quantization_config, skip_modules=["lm_head", "embed"]
+        )
+    q_tree = quantize_params(model.params, quantization_config)
+    module = model.module
+    if module is None:
+        raise ValueError(
+            "load_and_quantize_model needs a Model built from a flax module "
+            "(Model.from_flax); apply_fn-only models have no module to re-apply."
+        )
+    dtype = quantization_config.compute_dtype
+
+    @jax.jit
+    def _fwd(qp, args, rngs, kwargs):
+        extra = {"rngs": rngs} if rngs else {}
+        return module.apply({"params": dequantize_params(qp, dtype)}, *args, **extra, **kwargs)
+
+    class _QuantizedModel(Model):
+        def __call__(self, *args, rngs=None, train: bool = False, **kwargs):
+            if train:
+                raise ValueError(
+                    "Weight-only quantized models are inference-only "
+                    "(the reference's bnb models are too, utils/bnb.py:44-116)."
+                )
+            return _fwd(self.params, args, rngs, kwargs)
+
+    qm = _QuantizedModel.__new__(_QuantizedModel)
+    qm.__dict__.update(model.__dict__)
+    qm._accelerator = None  # detached inference model: never write back into a train state
+    qm.params = q_tree
+    qm.quantization_config = quantization_config
+    return qm

@@ -1,0 +1,42 @@
+"""LocalSGD (local_sgd.py) — single-process no-op + launched 2-process averaging."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_local_sgd_single_process_noop():
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator, LocalSGD, Model
+    from accelerate_tpu.test_utils.training import make_regression_model
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(0)
+    module, loss_fn = make_regression_model()
+    acc = Accelerator()
+    model = Model.from_flax(module, jax.random.key(0), np.zeros((4,), np.float32))
+    model, _ = acc.prepare(model, optax.sgd(0.1))
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    batch = {"x": x, "y": (2 * x + 1).astype(np.float32)}
+    with LocalSGD(acc, model, local_sgd_steps=2) as lsgd:
+        assert not lsgd.enabled  # one process → disabled, like the reference
+        for _ in range(6):
+            state, _ = step(state, batch)
+            lsgd.step()
+    assert float(np.asarray(state.params["a"])) != 0.0
+
+
+@pytest.mark.slow
+def test_local_sgd_multiprocess_averages():
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_local_sgd"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
+    assert "LOCALSGD OK" in out

@@ -1,0 +1,115 @@
+"""int8/int4 weight-only quantization (utils/quantization.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_int8_roundtrip_error():
+    from accelerate_tpu.utils import dequantize_tensor, quantize_tensor_int8
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    qt = quantize_tensor_int8(w)
+    assert qt.data.dtype == jnp.int8
+    back = dequantize_tensor(qt, jnp.float32)
+    # int8 per-channel: ~0.5/127 of the channel amax worst case.
+    err = float(jnp.max(jnp.abs(back - w)))
+    amax = float(jnp.max(jnp.abs(w)))
+    assert err <= amax / 127.0 * 1.01, (err, amax)
+
+
+def test_int4_pack_unpack_exact():
+    from accelerate_tpu.utils.quantization import _unpack_int4
+
+    vals = jnp.asarray(np.arange(16, dtype=np.uint8).repeat(2)[:28].reshape(28, 1))
+    packed = (vals[1::2] << 4) | vals[0::2]
+    unpacked = _unpack_int4(packed)
+    np.testing.assert_array_equal(np.asarray(unpacked), np.asarray(vals))
+
+
+def test_int4_roundtrip_grouped():
+    from accelerate_tpu.utils import dequantize_tensor, quantize_tensor_int4
+
+    rng = np.random.default_rng(1)
+    for shape in [(130, 48), (2, 128, 48)]:  # pad case + stacked scan-layer case
+        w = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        qt = quantize_tensor_int4(w, group_size=64)
+        back = dequantize_tensor(qt, jnp.float32)
+        assert back.shape == w.shape
+        # NF4 is MSE-optimal for gaussian weights: judge by normalized RMS
+        # (its max error near the distribution tails is deliberately coarse).
+        err = np.asarray(back - w)
+        rms = float(np.sqrt((err**2).mean()) / np.abs(np.asarray(w)).max())
+        assert rms < 0.05, (shape, rms)
+    # Packed storage ~half a byte per weight (+ scales) on group-aligned shapes.
+    w = jnp.asarray(rng.normal(size=(128, 48)).astype(np.float32))
+    assert quantize_tensor_int4(w, 64).nbytes_packed < w.size * 0.75
+
+
+def test_quantize_params_filters():
+    from accelerate_tpu.utils import QuantizationConfig, quantize_params
+    from accelerate_tpu.utils.quantization import is_quantized
+
+    rng = np.random.default_rng(2)
+    params = {
+        "mlp": {"kernel": jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))},
+        "norm": {"scale": jnp.ones((128,), jnp.float32)},            # 1-D: skip
+        "small": {"kernel": jnp.ones((4, 4), jnp.float32)},          # tiny: skip
+        "head": {"kernel": jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))},
+    }
+    cfg = QuantizationConfig(load_in_8bit=True, skip_modules=["head"])
+    q = quantize_params(params, cfg)
+    assert is_quantized(q["mlp"]["kernel"])
+    assert not is_quantized(q["norm"]["scale"])
+    assert not is_quantized(q["small"]["kernel"])
+    assert not is_quantized(q["head"]["kernel"])
+
+
+def test_mutually_exclusive_bits():
+    from accelerate_tpu.utils import QuantizationConfig
+
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    with pytest.raises(ValueError):
+        QuantizationConfig()
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_load_and_quantize_llama(bits):
+    """Quantized tiny-Llama forward stays close to fp32 and shrinks storage."""
+    from accelerate_tpu import Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import (
+        QuantizationConfig,
+        load_and_quantize_model,
+        quantized_nbytes,
+    )
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 16), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    ref_logits = np.asarray(model(ids), np.float32)
+    full_bytes = sum(l.nbytes for l in jax.tree.leaves(model.params))
+
+    qcfg = QuantizationConfig(
+        load_in_8bit=bits == 8, load_in_4bit=bits == 4, compute_dtype=jnp.float32
+    )
+    qm = load_and_quantize_model(model, qcfg)
+    q_logits = np.asarray(qm(ids), np.float32)
+    assert quantized_nbytes(qm.params) < full_bytes * (0.45 if bits == 8 else 0.35)
+    # Logits track full precision closely (random tiny nets have near-uniform
+    # logits, so cosine similarity is the robust check; argmax agreement is a
+    # secondary, looser one).
+    cos = np.sum(q_logits * ref_logits) / (
+        np.linalg.norm(q_logits) * np.linalg.norm(ref_logits)
+    )
+    # int8 tracks tightly; NF4 on an UNTRAINED gaussian net is a worst case
+    # (no outlier structure, tails dominate) — real checkpoints do better.
+    assert cos > (0.999 if bits == 8 else 0.94), cos
+    if bits == 8:
+        agree = np.mean(np.argmax(q_logits, -1) == np.argmax(ref_logits, -1))
+        assert agree > 0.85, agree
