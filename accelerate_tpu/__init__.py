@@ -31,6 +31,7 @@ from .model import Model  # noqa: E402
 from .accelerator import Accelerator  # noqa: E402
 from .data_loader import (  # noqa: E402
     BatchSamplerShard,
+    ColumnDataset,
     DataLoaderShard,
     IterableDatasetShard,
     SeedableRandomSampler,

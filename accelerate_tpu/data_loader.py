@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import math
 import random as _pyrandom
+import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
@@ -281,7 +282,8 @@ class IterableDatasetShard:
 
 def default_collate(samples: list) -> Any:
     """Stack a list of samples into a batch of numpy arrays (dicts, tuples and
-    scalars supported). Torch tensors are converted host-side."""
+    scalars supported). Torch tensors are converted host-side. Large uniform
+    items go through the native parallel-memcpy stacker (native/)."""
     first = samples[0]
     if hasattr(first, "numpy"):  # torch tensor
         return np.stack([np.asarray(s.numpy() if hasattr(s, "numpy") else s) for s in samples])
@@ -289,7 +291,41 @@ def default_collate(samples: list) -> Any:
         return {k: default_collate([s[k] for s in samples]) for k in first}
     if isinstance(first, (tuple, list)):
         return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, np.ndarray) and first.ndim > 0:
+        from .native import stack_items
+
+        return stack_items(samples)
     return np.asarray(samples)
+
+
+class ColumnDataset:
+    """Dict-of-arrays dataset whose batches assemble in ONE native call per
+    batch (``native.gather_columns``) instead of a Python loop per item —
+    the torch-DataLoader-worker role (SURVEY.md §2.9) done TPU-host-native.
+
+    ``dataset[i]`` still returns a per-item dict, so it composes with every
+    sampler/shard wrapper in this module.
+    """
+
+    def __init__(self, **columns: np.ndarray):
+        if not columns:
+            raise ValueError("ColumnDataset needs at least one column")
+        lengths = {k: len(v) for k, v in columns.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Column lengths differ: {lengths}")
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        self._length = next(iter(lengths.values()))
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, i):
+        return {k: v[i] for k, v in self.columns.items()}
+
+    def gather_batch(self, indices) -> dict[str, np.ndarray]:
+        from .native import gather_columns
+
+        return gather_columns(self.columns, indices)
 
 
 def _to_numpy_tree(batch):
@@ -299,6 +335,67 @@ def _to_numpy_tree(batch):
         return x
 
     return recursively_apply(_conv, batch, test_type=lambda x: hasattr(x, "detach") or hasattr(x, "shape"))
+
+
+class _PrefetchIterator:
+    """Bounded background iterator: a worker thread runs the source iterator
+    (dataset reads + native collation, which releases the GIL) while the main
+    thread feeds the device — the reference's ``MpDeviceLoader`` prefetch
+    threads (reference: data_loader.py:669-719) without torch_xla."""
+
+    _SENTINEL = object()
+
+    def __init__(self, source, prefetch_size: int = 2):
+        import queue
+
+        self._queue = queue.Queue(maxsize=max(1, prefetch_size))
+        self._stop = threading.Event()
+        self._error = None
+
+        def _fill():
+            try:
+                for item in source:
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                self._error = e
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=_fill, daemon=True, name="accel-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # Drain so the worker unblocks and exits.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
 
 
 class BaseDataLoader:
@@ -330,6 +427,11 @@ class BaseDataLoader:
         self.end_of_dataloader = False
         self.remainder = -1
         self._iter_count = 0
+        # Background host-side batch assembly (the MpDeviceLoader role,
+        # reference: data_loader.py:669-719): a worker thread keeps this many
+        # batches ready; native collation releases the GIL so assembly truly
+        # overlaps the device step. 0 disables.
+        self.prefetch_size = kwargs.get("prefetch_size", 2)
 
     # -- device side -----------------------------------------------------
 
@@ -374,6 +476,8 @@ class BaseDataLoader:
         self.end_of_dataloader = False
         try:
             iterator = self._raw_batches()
+            if self.prefetch_size and self.prefetch_size > 0:
+                iterator = _PrefetchIterator(iterator, self.prefetch_size)
             try:
                 current = next(iterator)
             except StopIteration:
@@ -388,6 +492,8 @@ class BaseDataLoader:
                 yield self._device_put_batch(current)
                 current = nxt
         finally:
+            if isinstance(iterator, _PrefetchIterator):
+                iterator.close()
             self.end()
 
     def begin(self):
@@ -437,7 +543,18 @@ class DataLoaderShard(BaseDataLoader):
         return len(self.batch_sampler)
 
     def _raw_batches(self):
+        fast = self.collate_fn is default_collate
         for batch_indices in self.batch_sampler:
+            # Native batch-assembly fast paths (one gather instead of a
+            # Python loop per item) for array-backed datasets.
+            if fast and isinstance(self.dataset, ColumnDataset):
+                yield self.dataset.gather_batch(batch_indices)
+                continue
+            if fast and isinstance(self.dataset, np.ndarray) and self.dataset.ndim > 0:
+                from .native import gather_rows
+
+                yield gather_rows(self.dataset, batch_indices)
+                continue
             samples = [self.dataset[i] for i in batch_indices]
             yield self.collate_fn(samples)
 

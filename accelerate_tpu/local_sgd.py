@@ -59,6 +59,11 @@ class LocalSGD:
         accelerator's tracked state (the imperative-API path).
         """
         self.num_steps += 1
+        if state is not None:
+            # Adopt the caller's fresh state so a user-written jitted step
+            # (not acc.prepare_train_step, which tracks automatically) is what
+            # gets averaged — and never lose its progress.
+            self.accelerator._train_state = state
         if self.enabled and self.num_steps % self.local_sgd_steps == 0:
             self._sync_params()
         tracked = self.accelerator._train_state

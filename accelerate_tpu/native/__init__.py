@@ -1,0 +1,169 @@
+"""ctypes bindings for the native host-runtime kernels (host_runtime.cpp).
+
+The shared library is built lazily with the system g++ on first use and
+cached next to the source; everything degrades to numpy when a compiler is
+unavailable or ``ACCELERATE_DISABLE_NATIVE=1`` is set, so the package never
+hard-requires a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_runtime.cpp")
+_LIB_PATH = os.path.join(_HERE, "libhost_runtime.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+# Below this many bytes a plain numpy fancy-index wins; and on a single-core
+# host the parallel path cannot beat numpy's memcpy loop at all, so the
+# native kernels only engage with >=2 cores (real TPU-VM hosts have ~100).
+NATIVE_MIN_BYTES = 1 << 20
+_NUM_THREADS = min(8, os.cpu_count() or 1)
+_MULTICORE = (os.cpu_count() or 1) >= 2
+
+
+def native_disabled() -> bool:
+    return os.environ.get("ACCELERATE_DISABLE_NATIVE", "").lower() in ("1", "true", "yes")
+
+
+def _build() -> bool:
+    # Compile to a per-process temp name, then atomically rename: several
+    # launched ranks on one host may build concurrently, and dlopen of a
+    # partially-linked file must be impossible.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        result = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if result.returncode != 0:
+            return False
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except OSError:
+        return False
+    except subprocess.TimeoutExpired:
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def get_lib():
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed or native_disabled():
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            stale = (
+                not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                _lib_failed = True
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.at_gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.at_stack_ptrs.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.at_gather_columns.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ]
+            lib.at_version.restype = ctypes.c_int
+            assert lib.at_version() == 1
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def gather_rows(src: np.ndarray, indices, force: bool = False) -> np.ndarray:
+    """out[j] = src[indices[j]] — parallel memcpy gather for large batches,
+    numpy fancy indexing otherwise."""
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    total = row_bytes * len(idx)
+    eligible = force or (_MULTICORE and total >= NATIVE_MIN_BYTES)
+    lib = get_lib() if eligible else None
+    if lib is None or not src.flags.c_contiguous or src.dtype.hasobject:
+        return src[idx]
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    lib.at_gather_rows(
+        src.ctypes.data, row_bytes, idx.ctypes.data, len(idx),
+        out.ctypes.data, _NUM_THREADS,
+    )
+    return out
+
+
+def gather_columns(columns: dict[str, np.ndarray], indices, force: bool = False) -> dict[str, np.ndarray]:
+    """One-call batch assembly for a dict-of-arrays dataset."""
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    names = list(columns)
+    arrays = [columns[k] for k in names]
+    total = sum(
+        a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64)) for a in arrays
+    ) * len(idx)
+    eligible = force or (_MULTICORE and total >= NATIVE_MIN_BYTES)
+    lib = get_lib() if eligible else None
+    if lib is None or not all(
+        a.flags.c_contiguous and not a.dtype.hasobject for a in arrays
+    ):
+        return {k: columns[k][idx] for k in names}
+    outs = [np.empty((len(idx),) + a.shape[1:], dtype=a.dtype) for a in arrays]
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    row_bytes = np.asarray(
+        [a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64)) for a in arrays],
+        dtype=np.int64,
+    )
+    lib.at_gather_columns(
+        srcs, row_bytes.ctypes.data, n, idx.ctypes.data, len(idx), dsts, _NUM_THREADS
+    )
+    return dict(zip(names, outs))
+
+
+def stack_items(items: list, force: bool = False) -> np.ndarray:
+    """np.stack with a parallel-memcpy fast path for big uniform items."""
+    first = np.asarray(items[0])
+    item_bytes = first.nbytes
+    total = item_bytes * len(items)
+    eligible = force or (_MULTICORE and total >= NATIVE_MIN_BYTES)
+    lib = get_lib() if eligible else None
+    arrays = [np.asarray(x) for x in items]
+    if (
+        lib is None
+        or first.dtype.hasobject
+        or not all(
+            a.flags.c_contiguous and a.shape == first.shape and a.dtype == first.dtype
+            for a in arrays
+        )
+    ):
+        return np.stack(arrays)
+    out = np.empty((len(arrays),) + first.shape, dtype=first.dtype)
+    ptrs = (ctypes.c_void_p * len(arrays))(*[a.ctypes.data for a in arrays])
+    lib.at_stack_ptrs(ptrs, item_bytes, len(arrays), out.ctypes.data, _NUM_THREADS)
+    return out

@@ -1,0 +1,165 @@
+// Native host-side data-path kernels (layer L3 hot path).
+//
+// The reference delegates batch assembly to torch's C++ DataLoader machinery
+// (pin-memory threads + C collate, SURVEY.md §2.9); this is the TPU-native
+// equivalent: multithreaded row gather / item stacking into contiguous
+// batch buffers, called from Python through ctypes (which releases the GIL
+// for the duration, so a Python-thread prefetcher gets real overlap with
+// device compute).
+//
+// Build: g++ -O3 -shared -fPIC -pthread host_runtime.cpp -o libhost_runtime.so
+// (done lazily by accelerate_tpu/native/__init__.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+// Persistent worker pool: spawning std::threads per call costs more than a
+// typical batch memcpy, so workers are created once and parked on a condvar.
+class Pool {
+ public:
+  explicit Pool(int nthreads) : nthreads_(nthreads) {
+    for (int t = 0; t < nthreads; ++t) {
+      workers_.emplace_back([this, t]() { Run(t); });
+    }
+  }
+
+  // Blocks until fn(begin, end) has covered [0, n) across the pool.
+  // Serialized: ctypes releases the GIL, so concurrent Python threads (e.g.
+  // two prefetching dataloaders) may call in simultaneously.
+  void ParallelFor(int64_t n, const std::function<void(int64_t, int64_t)>& fn) {
+    if (n <= 0) return;
+    std::lock_guard<std::mutex> call_lk(call_m_);
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      fn_ = &fn;
+      n_ = n;
+      chunk_ = std::max<int64_t>(1, (n + nthreads_) / (nthreads_ + 1));
+      next_ = 0;
+      pending_ = nthreads_;
+      ++epoch_;
+    }
+    cv_.notify_all();
+    // The calling thread works too.
+    Drain(fn);
+    std::unique_lock<std::mutex> lk(m_);
+    done_cv_.wait(lk, [this]() { return pending_ == 0; });
+    fn_ = nullptr;
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      stop_ = true;
+      ++epoch_;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+ private:
+  void Drain(const std::function<void(int64_t, int64_t)>& fn) {
+    while (true) {
+      int64_t begin = next_.fetch_add(chunk_);
+      if (begin >= n_) break;
+      fn(begin, std::min<int64_t>(begin + chunk_, n_));
+    }
+  }
+
+  void Run(int t) {
+    uint64_t seen = 0;
+    while (true) {
+      const std::function<void(int64_t, int64_t)>* fn;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait(lk, [&]() { return stop_ || epoch_ != seen; });
+        if (stop_) return;
+        seen = epoch_;
+        fn = fn_;
+      }
+      if (fn) Drain(*fn);
+      {
+        std::lock_guard<std::mutex> lk(m_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  int nthreads_;
+  std::vector<std::thread> workers_;
+  std::mutex call_m_;  // one ParallelFor at a time
+  std::mutex m_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int64_t, int64_t)>* fn_ = nullptr;
+  int64_t n_ = 0, chunk_ = 1;
+  std::atomic<int64_t> next_{0};
+  int pending_ = 0;
+  uint64_t epoch_ = 0;
+  bool stop_ = false;
+};
+
+Pool* GetPool(int nthreads) {
+  static Pool* pool = new Pool(std::max(1, nthreads - 1));
+  return pool;
+}
+
+template <typename F>
+void parallel_for(int64_t n, int nthreads, F fn) {
+  if (nthreads <= 1 || n < 2) {
+    fn(0, n);
+    return;
+  }
+  std::function<void(int64_t, int64_t)> f = fn;
+  GetPool(nthreads)->ParallelFor(n, f);
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[j, :] = src[idx[j], :] for row_bytes-sized rows.
+void at_gather_rows(const char* src, int64_t row_bytes, const int64_t* idx,
+                    int64_t n, char* dst, int nthreads) {
+  parallel_for(n, nthreads, [=](int64_t begin, int64_t end) {
+    for (int64_t j = begin; j < end; ++j) {
+      std::memcpy(dst + j * row_bytes, src + idx[j] * row_bytes, row_bytes);
+    }
+  });
+}
+
+// dst[j, :] = *srcs[j] for item_bytes-sized independent items.
+void at_stack_ptrs(const char** srcs, int64_t item_bytes, int64_t n, char* dst,
+                   int nthreads) {
+  parallel_for(n, nthreads, [=](int64_t begin, int64_t end) {
+    for (int64_t j = begin; j < end; ++j) {
+      std::memcpy(dst + j * item_bytes, srcs[j], item_bytes);
+    }
+  });
+}
+
+// Gather rows from several parallel column arrays in one call (one batch of a
+// dict-of-arrays dataset): for each column c, dsts[c][j] = srcs[c][idx[j]].
+void at_gather_columns(const char** srcs, const int64_t* row_bytes,
+                       int64_t ncols, const int64_t* idx, int64_t n,
+                       char** dsts, int nthreads) {
+  parallel_for(n * ncols, nthreads, [=](int64_t begin, int64_t end) {
+    for (int64_t k = begin; k < end; ++k) {
+      int64_t c = k / n;
+      int64_t j = k % n;
+      std::memcpy(dsts[c] + j * row_bytes[c], srcs[c] + idx[j] * row_bytes[c],
+                  row_bytes[c]);
+    }
+  });
+}
+
+int at_version() { return 1; }
+
+}  // extern "C"

@@ -227,9 +227,12 @@ def load_and_quantize_model(
     dtype = quantization_config.compute_dtype
 
     @jax.jit
-    def _fwd(qp, args, rngs, kwargs):
-        extra = {"rngs": rngs} if rngs else {}
-        return module.apply({"params": dequantize_params(qp, dtype)}, *args, **extra, **kwargs)
+    def _fwd(qp, extra_state, args, rngs, kwargs):
+        call = {"rngs": rngs} if rngs else {}
+        variables = {"params": dequantize_params(qp, dtype)}
+        if extra_state:
+            variables.update(extra_state)  # batch_stats / cache collections
+        return module.apply(variables, *args, **call, **kwargs)
 
     class _QuantizedModel(Model):
         def __call__(self, *args, rngs=None, train: bool = False, **kwargs):
@@ -238,7 +241,7 @@ def load_and_quantize_model(
                     "Weight-only quantized models are inference-only "
                     "(the reference's bnb models are too, utils/bnb.py:44-116)."
                 )
-            return _fwd(self.params, args, rngs, kwargs)
+            return _fwd(self.params, self.extra_state, args, rngs, kwargs)
 
     qm = _QuantizedModel.__new__(_QuantizedModel)
     qm.__dict__.update(model.__dict__)

@@ -1,0 +1,129 @@
+"""Native host-runtime kernels (native/host_runtime.cpp) + loader wiring."""
+
+import numpy as np
+import pytest
+
+
+def _lib_available():
+    from accelerate_tpu import native
+
+    return native.get_lib() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _lib_available(), reason="g++ unavailable — native kernels disabled"
+)
+
+
+def test_gather_rows_matches_numpy():
+    from accelerate_tpu import native
+
+    rng = np.random.default_rng(0)
+    src = rng.normal(size=(1000, 33)).astype(np.float32)
+    idx = rng.integers(0, 1000, size=257)
+    out = native.gather_rows(src, idx, force=True)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_gather_rows_noncontiguous_falls_back():
+    from accelerate_tpu import native
+
+    rng = np.random.default_rng(1)
+    src = rng.normal(size=(100, 64)).astype(np.float32)[:, ::2]  # not C-contiguous
+    idx = np.arange(50)
+    out = native.gather_rows(src, idx, force=True)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_gather_columns_matches_numpy():
+    from accelerate_tpu import native
+
+    rng = np.random.default_rng(2)
+    cols = {
+        "x": rng.normal(size=(500, 16)).astype(np.float32),
+        "y": rng.integers(0, 9, size=(500,)).astype(np.int64),
+        "z": rng.normal(size=(500, 4, 3)).astype(np.float64),
+    }
+    idx = rng.integers(0, 500, size=123)
+    out = native.gather_columns(cols, idx, force=True)
+    for k in cols:
+        np.testing.assert_array_equal(out[k], cols[k][idx])
+
+
+def test_stack_items_matches_numpy():
+    from accelerate_tpu import native
+
+    rng = np.random.default_rng(3)
+    items = [rng.normal(size=(17, 5)).astype(np.float32) for _ in range(64)]
+    out = native.stack_items(items, force=True)
+    np.testing.assert_array_equal(out, np.stack(items))
+
+
+def test_column_dataset_loader_batches():
+    """ColumnDataset assembles identical batches to per-item collation."""
+    from accelerate_tpu.data_loader import ColumnDataset, prepare_data_loader
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 2, size=(64,)).astype(np.int32)
+    ds = ColumnDataset(x=x, y=y)
+    assert len(ds) == 64
+    assert set(ds[3]) == {"x", "y"}
+
+    class _Spec:
+        def __init__(self, dataset, batch_size):
+            self.dataset = dataset
+            self.batch_size = batch_size
+            self.sampler = None
+            self.drop_last = False
+
+    dl = prepare_data_loader(_Spec(ds, 16), put_on_device=False, use_seedable_sampler=False)
+    seen_x, seen_y = [], []
+    for b in dl:
+        assert b["x"].shape == (16, 8)
+        seen_x.append(np.asarray(b["x"]))
+        seen_y.append(np.asarray(b["y"]))
+    np.testing.assert_array_equal(np.concatenate(seen_x), x)
+    np.testing.assert_array_equal(np.concatenate(seen_y), y)
+
+
+def test_ndarray_dataset_fast_path():
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    data = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+
+    class _Spec:
+        def __init__(self, dataset, batch_size):
+            self.dataset = dataset
+            self.batch_size = batch_size
+            self.sampler = None
+            self.drop_last = False
+
+    dl = prepare_data_loader(_Spec(data, 8), put_on_device=False, use_seedable_sampler=False)
+    batches = [np.asarray(b) for b in dl]
+    np.testing.assert_array_equal(np.concatenate(batches), data)
+
+
+def test_prefetch_iterator_order_and_errors():
+    from accelerate_tpu.data_loader import _PrefetchIterator
+
+    it = _PrefetchIterator(iter(range(100)), prefetch_size=4)
+    assert list(it) == list(range(100))
+
+    def boom():
+        yield 1
+        raise RuntimeError("inner failure")
+
+    it = _PrefetchIterator(boom(), prefetch_size=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="inner failure"):
+        next(it)
+    it.close()
+
+
+def test_prefetch_close_mid_iteration():
+    from accelerate_tpu.data_loader import _PrefetchIterator
+
+    it = _PrefetchIterator(iter(range(10_000)), prefetch_size=2)
+    assert next(it) == 0
+    it.close()  # must not hang
