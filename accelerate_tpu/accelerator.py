@@ -477,6 +477,7 @@ class Accelerator:
             use_seedable_sampler=cfg.use_seedable_sampler,
             data_seed=cfg.data_seed,
             non_blocking=cfg.non_blocking,
+            prefetch_size=cfg.prefetch_size,
         )
         self._dataloaders.append(prepared)
         return prepared

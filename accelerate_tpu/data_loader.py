@@ -585,6 +585,12 @@ class DataLoaderDispatcher(BaseDataLoader):
     def __init__(self, dataset, batch_sampler=None, split_batches: bool = False, **kwargs):
         super().__init__(dataset, batch_sampler=batch_sampler, **kwargs)
         self.split_batches = split_batches
+        if PartialState().num_processes > 1:
+            # Dispatch mode runs broadcast collectives inside _raw_batches;
+            # those must stay on the main thread, interleaved in the same
+            # order on every rank — a prefetch thread would race them against
+            # the step's collectives and deadlock.
+            self.prefetch_size = 0
 
     def __len__(self):
         return len(self.batch_sampler)
@@ -643,6 +649,7 @@ def prepare_data_loader(
     non_blocking: bool = True,
     use_stateful_dataloader: bool = False,
     torch_device_mesh=None,
+    prefetch_size: int = 2,
 ) -> BaseDataLoader:
     """Factory turning a user dataloader/dataset into a mesh-aware loader
     (reference: data_loader.py:1014-1327).
@@ -704,6 +711,7 @@ def prepare_data_loader(
             collate_fn=collate_fn,
             device_placement=put_on_device,
             rng_types=rng_types,
+            prefetch_size=prefetch_size,
         )
 
     if use_seedable_sampler and shuffle:
@@ -731,6 +739,7 @@ def prepare_data_loader(
             collate_fn=collate_fn,
             device_placement=put_on_device,
             rng_types=rng_types,
+            prefetch_size=prefetch_size,
         )
     sharded = BatchSamplerShard(
         inner,
@@ -745,6 +754,7 @@ def prepare_data_loader(
         collate_fn=collate_fn,
         device_placement=put_on_device,
         rng_types=rng_types,
+        prefetch_size=prefetch_size,
     )
 
 

@@ -5,6 +5,20 @@ from .llama import (
     cross_entropy_loss,
     llama_tp_rules,
 )
+from .gpt2 import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    GPT2Model,
+    gpt2_tp_rules,
+)
+from .bert import (
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
+    bert_tp_rules,
+    masked_lm_loss,
+)
 from .moe import (
     MixtralConfig,
     MixtralForCausalLM,

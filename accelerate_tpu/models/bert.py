@@ -1,0 +1,241 @@
+"""BERT-family encoder, TPU-first.
+
+The reference reaches BERT only through the Megatron-LM engine
+(reference: utils/megatron_lm.py:356-520 `BertTrainStep`, model-provider
+machinery) — here it is a native flax family with the same design points as
+models/llama.py: MXU-shaped fused projections, optional ``nn.scan`` over
+identical blocks (regional-compilation analog), optional remat, a
+Megatron-style column/row TP rule table, and an optional fp8 matmul recipe.
+
+Architecture follows the classic post-LN BERT: embeddings (word + learned
+position + token type) → LN → N blocks of [self-attention → add&LN →
+GELU-FFN → add&LN] → pooler / task heads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    fp8: bool = False
+    fp8_format: str = "HYBRID"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def dot_general(self):
+        if not self.fp8:
+            return None
+        from ..ops.fp8 import fp8_dot_general
+
+        return fp8_dot_general(self.fp8_format)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=256,
+            max_position_embeddings=128, hidden_dropout_prob=0.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def bert_base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def bert_large(cls, **kw):
+        return cls(
+            hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+            intermediate_size=4096, **kw,
+        )
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool = True):
+        cfg = self.config
+        d = cfg.head_dim
+        dense = partial(
+            nn.DenseGeneral, features=(cfg.num_attention_heads, d), dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )
+        q = dense(name="query")(x)
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        if mask is not None:
+            big_neg = jnp.finfo(scores.dtype).min
+            scores = jnp.where(mask[:, None, None, :].astype(bool), scores, big_neg)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="output",
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )(out)
+
+
+class BertBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool = True):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(x, mask, deterministic)
+        attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="attention_norm")(x + attn)
+        dense = partial(
+            nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32,
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )
+        h = dense(cfg.intermediate_size, name="intermediate")(x)
+        h = nn.gelu(h)
+        h = dense(cfg.hidden_size, name="output")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="output_norm")(x + h)
+
+
+class _ScannedBertBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic):
+        x = BertBlock(self.config, name="block")(x, mask, deterministic)
+        return x, None
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = partial(nn.Embed, dtype=cfg.dtype, param_dtype=jnp.float32)
+        x = embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings")(input_ids)
+        positions = jnp.arange(input_ids.shape[-1])
+        x = x + embed(cfg.max_position_embeddings, cfg.hidden_size,
+                      name="position_embeddings")(positions)
+        x = x + embed(cfg.type_vocab_size, cfg.hidden_size,
+                      name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="embeddings_norm")(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+
+        block_cls = _ScannedBertBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, name="layers")(x, attention_mask, deterministic)
+        else:
+            blk = nn.remat(BertBlock, prevent_cse=False) if cfg.remat else BertBlock
+            for i in range(cfg.num_hidden_layers):
+                x = blk(cfg, name=f"layer_{i}")(x, attention_mask, deterministic)
+
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = nn.tanh(
+                nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="pooler")(x[:, 0])
+            )
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        _, pooled = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic
+        )
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(pooled, deterministic=deterministic)
+        return nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+class BertForMaskedLM(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        x, _ = BertModel(cfg, add_pooling_layer=False, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic
+        )
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="transform")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_norm")(x)
+        # Decoder tied to word embeddings (standard BERT MLM head).
+        embedding = self.variables["params"]["bert"]["word_embeddings"]["embedding"]
+        logits = x @ embedding.T.astype(cfg.dtype)
+        bias = self.param("decoder_bias", nn.initializers.zeros, (cfg.vocab_size,))
+        return (logits + bias).astype(jnp.float32)
+
+
+def bert_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    """Megatron column/row-parallel table for BERT (regex on "/"-joined param
+    paths → dim-aligned PartitionSpec tuples; see parallel/sharding.py)."""
+    lead = (None,) if scan_layers else ()
+    return [
+        # Column-parallel: heads / ffn output dim sharded.
+        (r"attention/(query|key|value)/kernel", lead + (None, "tp", None)),
+        (r"intermediate/kernel", lead + (None, "tp")),
+        # Row-parallel: input dim sharded, psum on output.
+        (r"attention/output/kernel", lead + ("tp", None, None)),
+        (r"(?<!attention/)output/kernel", lead + ("tp", None)),
+        # Embeddings shard the vocab dim.
+        (r"word_embeddings/embedding", ("tp", None)),
+    ]
+
+
+def masked_lm_loss(logits, labels, ignore_index: int = -100):
+    """Cross entropy over masked positions only (labels==ignore_index skipped)."""
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)

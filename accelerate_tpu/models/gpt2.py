@@ -1,0 +1,170 @@
+"""GPT-2-family decoder, TPU-first.
+
+The reference reaches GPT training only through Megatron-LM
+(reference: utils/megatron_lm.py:574-700 `GPTTrainStep`); here it is a native
+flax family. Distinct from models/llama.py where it matters architecturally:
+learned absolute position embeddings (no RoPE), pre-LN blocks with standard
+LayerNorm (not RMSNorm), GELU MLP (not SwiGLU), fused c_attn QKV projection,
+and word-embedding-tied LM head — so checkpoints keep GPT-2 layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    fp8: bool = False
+    fp8_format: str = "HYBRID"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def dot_general(self):
+        if not self.fp8:
+            return None
+        from ..ops.fp8 import fp8_dot_general
+
+        return fp8_dot_general(self.fp8_format)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=256, n_positions=128, n_embd=128, n_layer=2, n_head=4)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def gpt2(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw):
+        return cls(n_embd=1600, n_layer=48, n_head=25, **kw)
+
+
+class GPT2Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = cfg.head_dim
+        # Fused QKV — one big MXU matmul (GPT-2's c_attn layout).
+        qkv = nn.DenseGeneral(
+            features=(3, cfg.n_head, d), dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="c_attn",
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        seq = x.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(causal[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=cfg.n_embd, axis=(-2, -1), dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="c_proj",
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )(out)
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x)
+        x = x + GPT2Attention(cfg, name="attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(x)
+        dense = partial(
+            nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32,
+            **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
+        )
+        h = dense(4 * cfg.n_embd, name="c_fc")(h)
+        h = nn.gelu(h)
+        return x + dense(cfg.n_embd, name="c_proj")(h)
+
+
+class _ScannedGPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, _):
+        return GPT2Block(self.config, name="block")(x), None
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="wte")(input_ids)
+        x = x + nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="wpe")(
+            jnp.arange(input_ids.shape[-1])
+        )
+        block_cls = _ScannedGPT2Block
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, name="h")(x, None)
+        else:
+            blk = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
+            for i in range(cfg.n_layer):
+                x = blk(cfg, name=f"h_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = GPT2Model(cfg, name="transformer")(input_ids)
+        # LM head tied to wte (GPT-2 always ties).
+        embedding = self.variables["params"]["transformer"]["wte"]["embedding"]
+        return (x @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def gpt2_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    lead = (None,) if scan_layers else ()
+    return [
+        # Fused QKV: kernel (in, 3, heads, d) — shard heads.
+        (r"attn/c_attn/kernel", lead + (None, None, "tp", None)),
+        (r"attn/c_proj/kernel", lead + ("tp", None, None)),   # row-parallel
+        (r"c_fc/kernel", lead + (None, "tp")),                 # column-parallel
+        (r"(?<!attn/)c_proj/kernel", lead + ("tp", None)),     # row-parallel MLP out
+        (r"wte/embedding", ("tp", None)),                      # vocab-sharded
+    ]

@@ -100,10 +100,28 @@ def get_lib():
     return _lib
 
 
+def _normalize_indices(indices, n: int):
+    """int64 contiguous in-range indices for the native path, or None when
+    numpy's richer semantics (bool masks, negatives out of a simple wrap,
+    IndexError on out-of-range) must handle it."""
+    arr = np.asarray(indices)
+    if arr.dtype == bool:
+        return None
+    idx = np.ascontiguousarray(arr, dtype=np.int64)
+    if idx.size and (idx.min() < -n or idx.max() >= n):
+        return None  # let numpy raise the IndexError
+    if idx.size and idx.min() < 0:
+        idx = np.where(idx < 0, idx + n, idx)
+        idx = np.ascontiguousarray(idx)
+    return idx
+
+
 def gather_rows(src: np.ndarray, indices, force: bool = False) -> np.ndarray:
     """out[j] = src[indices[j]] — parallel memcpy gather for large batches,
     numpy fancy indexing otherwise."""
-    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    idx = _normalize_indices(indices, len(src))
+    if idx is None:  # bool mask / negative / out-of-range → numpy semantics
+        return src[np.asarray(indices)]
     row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
     total = row_bytes * len(idx)
     eligible = force or (_MULTICORE and total >= NATIVE_MIN_BYTES)
@@ -120,9 +138,11 @@ def gather_rows(src: np.ndarray, indices, force: bool = False) -> np.ndarray:
 
 def gather_columns(columns: dict[str, np.ndarray], indices, force: bool = False) -> dict[str, np.ndarray]:
     """One-call batch assembly for a dict-of-arrays dataset."""
-    idx = np.ascontiguousarray(indices, dtype=np.int64)
     names = list(columns)
     arrays = [columns[k] for k in names]
+    idx = _normalize_indices(indices, len(arrays[0]))
+    if idx is None:
+        return {k: columns[k][np.asarray(indices)] for k in names}
     total = sum(
         a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64)) for a in arrays
     ) * len(idx)

@@ -1,0 +1,98 @@
+"""GPT-2 family (models/gpt2.py): shapes, causality, tied head, TP, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_lm_head_shapes_and_tying():
+    from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int32)
+    params = module.init(jax.random.key(0), ids)["params"]
+    logits = module.apply({"params": params}, ids)
+    assert logits.shape == (2, 12, cfg.vocab_size)
+    # Tied head: no separate lm_head kernel exists.
+    assert "lm_head" not in params
+
+
+def test_causality():
+    """Changing a future token never changes past logits."""
+    from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(1, 10), dtype=np.int32)
+    params = module.init(jax.random.key(0), ids)["params"]
+    out1 = np.asarray(module.apply({"params": params}, ids))
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 5) % cfg.vocab_size
+    out2 = np.asarray(module.apply({"params": params}, ids2))
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=1e-5, atol=1e-6)
+    assert not np.allclose(out1[:, -1], out2[:, -1])
+
+
+def test_gpt2_tp_matches_single_device():
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel, gpt2_tp_rules
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, size=(4, 16), dtype=np.int32)
+
+    def run(pc, tp):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(0)
+        acc = Accelerator(parallelism_config=pc)
+        model = Model.from_flax(
+            module, jax.random.key(0), ids,
+            tp_rules=gpt2_tp_rules(cfg.scan_layers) if tp else None,
+        )
+        model, _ = acc.prepare(model, optax.sgd(1e-2))
+        return np.asarray(model(ids), np.float32)
+
+    ref = run(ParallelismConfig(dp_shard_size=8), tp=False)
+    tp = run(ParallelismConfig(dp_shard_size=4, tp_size=2), tp=True)
+    np.testing.assert_allclose(ref, tp, rtol=1e-4, atol=1e-4)
+
+
+def test_gpt2_trains():
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel, cross_entropy_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    set_seed(0)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 17), dtype=np.int32)
+    acc = Accelerator()
+    model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+    model, _ = acc.prepare(model, optax.adam(1e-3))
+
+    def loss_fn(params, b):
+        return cross_entropy_loss(module.apply({"params": params}, b["x"]), b["y"])
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    b = {"x": ids[:, :-1], "y": ids[:, 1:]}
+    losses = []
+    for _ in range(10):
+        state, m = step(state, b)
+        losses.append(float(np.asarray(m["loss"])))
+    assert losses[-1] < losses[0], losses
