@@ -373,8 +373,13 @@ def to_global_host(tree):
         if isinstance(t, jax.Array) and not t.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(t, tiled=True))
-        return np.asarray(t)
+            return np.ascontiguousarray(
+                np.asarray(multihost_utils.process_allgather(t, tiled=True))
+            )
+        # np.asarray of a TPU array can expose the device's tiled layout as a
+        # strided view; downstream writers (safetensors, memmap, ctypes)
+        # assume C order, so normalize here at the host boundary.
+        return np.ascontiguousarray(np.asarray(t))
 
     return recursively_apply(_fetch, tree)
 

@@ -1,0 +1,64 @@
+"""Shared scaffolding for the by_feature examples: each script is the
+nlp_example training loop plus exactly one feature (the reference enforces
+this with an AST diff, tests/test_examples.py:70 — here the base is imported
+so the delta is visible directly)."""
+
+import os
+import sys
+
+_EXAMPLES = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _EXAMPLES)
+sys.path.insert(0, os.path.dirname(_EXAMPLES))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from nlp_example import NUM_CLASSES, EncoderClassifier, LoaderSpec, build_dataset
+
+
+def make_parser(**overrides):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=overrides.get("batch_size", 32))
+    parser.add_argument("--epochs", type=int, default=overrides.get("epochs", 2))
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    return parser
+
+
+def build_model_and_data(args, n_train=1024, n_eval=256):
+    module = EncoderClassifier()
+    train_ds = build_dataset(n_train, seed=0)
+    eval_ds = build_dataset(n_eval, seed=1)
+    sample = train_ds[0]
+    from accelerate_tpu import Model
+
+    model = Model.from_flax(
+        module, jax.random.key(args.seed),
+        sample["input_ids"][None], sample["attention_mask"][None],
+    )
+    return module, model, train_ds, eval_ds
+
+
+def classifier_loss(module):
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["input_ids"], batch["attention_mask"])
+        return optax.softmax_cross_entropy(
+            logits, jax.nn.one_hot(batch["labels"], NUM_CLASSES)
+        ).mean()
+
+    return loss_fn
+
+
+def evaluate(accelerator, model, eval_dl):
+    correct = total = 0
+    for batch in eval_dl:
+        preds = jnp.argmax(model(batch["input_ids"], batch["attention_mask"]), -1)
+        g = accelerator.gather_for_metrics((preds, batch["labels"]))
+        correct += int((np.asarray(g[0]) == np.asarray(g[1])).sum())
+        total += len(np.asarray(g[0]))
+    return correct / max(total, 1)

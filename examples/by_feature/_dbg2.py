@@ -1,0 +1,44 @@
+from _base import build_model_and_data, classifier_loss, evaluate, make_parser
+import numpy as np, optax, jax
+import jax; jax.config.update("jax_platforms", "cpu")
+
+def main():
+    args = make_parser(epochs=2).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProjectConfiguration, set_seed
+    from accelerate_tpu.utils.other import load_sharded_safetensors, flatten_state_dict
+    from accelerate_tpu.utils.operations import to_global_host
+    import shutil; shutil.rmtree("/tmp/accelerate_tpu_ckpt_example", ignore_errors=True)
+
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir="/tmp/accelerate_tpu_ckpt_example", automatic_checkpoint_naming=True
+        ),
+    )
+    module, model, train_ds, eval_ds = build_model_and_data(args)
+    from _base import LoaderSpec
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+        accelerator.save_state()
+    # Compare live params vs what's on disk in the LAST checkpoint.
+    live = {k: np.asarray(v) for k, v in flatten_state_dict(to_global_host(accelerator.train_state.params)).items()}
+    disk = load_sharded_safetensors("/tmp/accelerate_tpu_ckpt_example/checkpoints/checkpoint_1", weights_name="model.safetensors")
+    print("keys equal:", set(live) == set(disk))
+    diffs = {k: float(np.abs(live[k] - disk[k]).max()) for k in live}
+    bad = {k: v for k, v in diffs.items() if v > 1e-6}
+    print("SAVE divergence:", dict(list(bad.items())[:4]) or "none")
+    d0 = load_sharded_safetensors("/tmp/accelerate_tpu_ckpt_example/checkpoints/checkpoint_0", weights_name="model.safetensors")
+    diffs0 = {k: float(np.abs(live[k] - d0[k]).max()) for k in live}
+    print("ckpt0 vs live max:", max(diffs0.values()))
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Feature: save_state / load_state round-trip with automatic checkpoint
+naming and mid-training resume (reference: examples/by_feature/checkpointing.py)."""
+
+import numpy as np
+import optax
+
+from _base import build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    args = make_parser(epochs=2).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir="/tmp/accelerate_tpu_ckpt_example", automatic_checkpoint_naming=True
+        ),
+    )
+    module, model, train_ds, eval_ds = build_model_and_data(args)
+    from _base import LoaderSpec
+
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+        accelerator.save_state()  # checkpoints/checkpoint_<epoch>
+
+    step_before = int(np.asarray(accelerator.train_state.step))
+    acc_before = evaluate(accelerator, model, eval_dl)
+
+    # Restore the latest checkpoint and prove the state round-trips.
+    accelerator.load_state()
+    assert int(np.asarray(accelerator.train_state.step)) == step_before
+    acc_after = evaluate(accelerator, model, eval_dl)
+    assert abs(acc_before - acc_after) < 1e-6, (acc_before, acc_after)
+    accelerator.print(f"checkpointing OK: accuracy {acc_after:.3f} at step {step_before}")
+
+
+if __name__ == "__main__":
+    main()

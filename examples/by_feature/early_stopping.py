@@ -1,0 +1,44 @@
+"""Feature: cross-process early stopping via set_trigger / check_trigger —
+any rank can flag a stop and ALL ranks see it (reference:
+examples/by_feature/early_stopping.py, accelerator.py:2852-2909)."""
+
+import numpy as np
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    args = make_parser(epochs=10).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    module, model, train_ds, eval_ds = build_model_and_data(args)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+
+    target_loss = 0.15
+    stopped_epoch = None
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+            # Local condition on this rank…
+            if float(np.asarray(metrics["loss"])) < target_loss:
+                accelerator.set_trigger()
+        # …checked collectively: stops every rank together.
+        if accelerator.check_trigger():
+            stopped_epoch = epoch
+            break
+    acc = evaluate(accelerator, model, eval_dl)
+    accelerator.print(f"early stopping OK: stopped at epoch {stopped_epoch}, accuracy {acc:.3f}")
+    assert stopped_epoch is not None and stopped_epoch < args.epochs - 1
+
+
+if __name__ == "__main__":
+    main()

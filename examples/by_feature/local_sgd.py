@@ -1,0 +1,36 @@
+"""Feature: LocalSGD — K local steps between cross-process parameter
+averages (reference: examples/by_feature/local_sgd.py)."""
+
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    parser = make_parser(epochs=2)
+    parser.add_argument("--local_sgd_steps", type=int, default=8)
+    args = parser.parse_args()
+    from accelerate_tpu import Accelerator, LocalSGD
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    module, model, train_ds, eval_ds = build_model_and_data(args)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+    with LocalSGD(accelerator, model, local_sgd_steps=args.local_sgd_steps) as lsgd:
+        for epoch in range(args.epochs):
+            for batch in train_dl:
+                state, metrics = step_fn(state, batch)
+                state = lsgd.step(state)
+    acc = evaluate(accelerator, model, eval_dl)
+    accelerator.print(f"local_sgd OK: accuracy {acc:.3f} "
+                      f"({'averaging active' if lsgd.enabled else 'single process, no-op'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Feature: automatic OOM-retrying batch size via find_executable_batch_size
+(reference: examples/by_feature/memory.py, utils/memory.py:119-187)."""
+
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=args.batch_size * 4)
+    def inner_training_loop(batch_size):
+        attempts.append(batch_size)
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(args.seed)
+        accelerator = Accelerator(mixed_precision=args.mixed_precision)
+        # Simulate an OOM for oversized batches so the decorator's halving
+        # loop is exercised even on hosts with plenty of memory.
+        if batch_size > args.batch_size:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Ran out of memory (simulated)")
+        module, model, train_ds, eval_ds = build_model_and_data(args)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optax.adamw(args.lr), LoaderSpec(train_ds, batch_size),
+            LoaderSpec(eval_ds, batch_size, shuffle=False),
+        )
+        step_fn = accelerator.prepare_train_step(classifier_loss(module))
+        state = accelerator.train_state
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+        return evaluate(accelerator, model, eval_dl), accelerator
+
+    acc, accelerator = inner_training_loop()
+    accelerator.print(f"memory OK: batch sizes tried {attempts}, accuracy {acc:.3f}")
+    assert len(attempts) > 1, "the halving loop should have retried"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Feature: profiling the train step with accelerator.profile() over
+jax.profiler (reference: examples/by_feature/profiler.py wrapping
+torch.profiler)."""
+
+import glob
+import os
+
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, make_parser
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProfileKwargs, set_seed
+
+    set_seed(args.seed)
+    trace_dir = "/tmp/accelerate_tpu_profile_example"
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        kwargs_handlers=[ProfileKwargs(output_trace_dir=trace_dir)],
+    )
+    module, model, train_ds, eval_ds = build_model_and_data(args, n_train=256)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+
+    with accelerator.profile() as prof:
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+
+    traces = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    accelerator.print(f"profiler OK: {len(traces)} trace artifacts under {trace_dir}")
+    assert traces, "no profiler output written"
+
+
+if __name__ == "__main__":
+    main()

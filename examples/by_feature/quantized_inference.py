@@ -1,0 +1,41 @@
+"""Feature: int8 / NF4 weight-only quantized inference (reference:
+bitsandbytes integration, utils/bnb.py)."""
+
+import numpy as np
+
+from _base import make_parser  # noqa: F401  (path setup)
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    args = make_parser().parse_args()
+    from accelerate_tpu import Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import (
+        QuantizationConfig, load_and_quantize_model, quantized_nbytes,
+    )
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(args.seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 16), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(args.seed), ids)
+    full = sum(l.nbytes for l in jax.tree.leaves(model.params))
+    ref = np.asarray(model(ids), np.float32)
+
+    for name, kwargs in [("int8", {"load_in_8bit": True}), ("nf4", {"load_in_4bit": True})]:
+        qm = load_and_quantize_model(
+            model, QuantizationConfig(compute_dtype=jnp.float32, **kwargs)
+        )
+        out = np.asarray(qm(ids), np.float32)
+        cos = float(np.sum(out * ref) / (np.linalg.norm(out) * np.linalg.norm(ref)))
+        ratio = quantized_nbytes(qm.params) / full
+        print(f"{name}: {ratio:.2f}x storage, logits cosine {cos:.4f}")
+        assert cos > 0.9
+    print("quantized inference OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Feature: experiment tracking via init_trackers / log / end_training
+(reference: examples/by_feature/tracking.py)."""
+
+import json
+import os
+
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    project_dir = "/tmp/accelerate_tpu_tracking_example"
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision, log_with="all", project_dir=project_dir
+    )
+    accelerator.init_trackers("tracking_example", config=vars(args))
+    module, model, train_ds, eval_ds = build_model_and_data(args)
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+    for epoch in range(args.epochs):
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.log({"accuracy": acc, "loss": float(metrics["loss"])}, step=epoch)
+    accelerator.end_training()
+
+    metrics_file = os.path.join(project_dir, "tracking_example.metrics.jsonl")
+    if accelerator.is_main_process and os.path.exists(metrics_file):
+        rows = [json.loads(l) for l in open(metrics_file)]
+        accelerator.print(f"tracking OK: {len(rows)} logged rows, last={rows[-1]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -146,3 +146,22 @@ def test_total_limit_pruning(tmp_path):
         acc.save_state()
     base = os.path.join(str(tmp_path), "checkpoints")
     assert len(os.listdir(base)) == 2
+
+
+def test_save_safetensors_noncontiguous_view():
+    """Non-C-contiguous host views (TPU device layouts surface this way) must
+    round-trip exactly — safetensors writes raw buffers without strides
+    (regression: silent checkpoint corruption of 3-D kernels on TPU)."""
+    import numpy as np
+
+    from accelerate_tpu.utils.other import load_safetensors, save_safetensors
+
+    base = np.arange(2 * 3 * 4, dtype=np.float32).reshape(4, 3, 2)
+    view = np.transpose(base, (2, 1, 0))  # strided, not C-contiguous
+    assert not view.flags.c_contiguous
+    import tempfile, os
+
+    path = os.path.join(tempfile.mkdtemp(), "t.safetensors")
+    save_safetensors({"k": view}, path)
+    back = load_safetensors(path)
+    np.testing.assert_array_equal(back["k"], view)

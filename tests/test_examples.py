@@ -1,0 +1,59 @@
+"""Examples run end-to-end on the virtual CPU mesh (reference analog:
+tests/test_examples.py FeatureExamplesTests). Each example self-asserts; the
+test just requires a clean exit."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def _run_example(rel_path, *args, timeout=420):
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (os.environ.get("PYTHONPATH"), os.getcwd()) if p
+        ),
+    }
+    path = os.path.join(EXAMPLES, rel_path)
+    # Pin the CPU mesh via jax.config BEFORE the example imports anything —
+    # env vars alone lose to site hooks that pre-register a device backend.
+    bootstrap = (
+        "import jax, runpy, sys; jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = [sys.argv[1]] + sys.argv[2:]; "
+        "runpy.run_path(sys.argv[0], run_name='__main__')"
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", bootstrap, path, *args],
+        cwd=os.path.dirname(path),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert result.returncode == 0, (
+        f"{rel_path} failed:\n--- stdout ---\n{result.stdout}\n--- stderr ---\n{result.stderr}"
+    )
+    return result.stdout
+
+
+@pytest.mark.slow
+def test_checkpointing_example():
+    out = _run_example("by_feature/checkpointing.py")
+    assert "checkpointing OK" in out
+
+
+@pytest.mark.slow
+def test_big_model_inference_example():
+    out = _run_example("by_feature/big_model_inference.py")
+    assert "big-model inference OK" in out
+
+
+@pytest.mark.slow
+def test_gradient_accumulation_example():
+    out = _run_example("by_feature/gradient_accumulation.py")
+    assert "grad-accum OK" in out
