@@ -1,0 +1,84 @@
+"""Launched check: cross-process collective ops preserve leaf shapes/dtypes.
+
+Mirrors the reference's ``test_utils/scripts/test_ops.py`` (193 LoC: gather /
+broadcast / pad / reduce on tensors and nested structures), with explicit
+0-d / 1-d / nested coverage — the exact class of bug that corrupted LocalSGD's
+scalar params in round 1 (process_allgather promotes 0-d leaves to (1,)).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils.operations import (
+    broadcast,
+    broadcast_object_list,
+    gather,
+    gather_object,
+    pad_across_processes,
+    reduce,
+    to_global_host,
+)
+
+acc = Accelerator()
+rank, world = acc.process_index, acc.num_processes
+assert world > 1, "this script must be launched with >1 process"
+
+
+def check(name, got, want_shape, want=None, dtype=None):
+    got = np.asarray(got)
+    assert got.shape == tuple(want_shape), f"{name}: shape {got.shape} != {want_shape}"
+    if dtype is not None:
+        assert got.dtype == dtype, f"{name}: dtype {got.dtype} != {dtype}"
+    if want is not None:
+        np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=name)
+
+
+# --- reduce: 0-d, 1-d, 2-d, nested — shapes must be preserved exactly -------
+scalar = np.array(1.0 + rank, np.float32)  # 0-d ndarray (np scalars pass through untouched)
+vec = np.full((3,), rank, np.float32)     # 1-d
+mat = np.full((2, 4), rank, np.float32)   # 2-d
+nested = {"a": scalar, "b": [vec, {"c": mat}]}
+
+mean_scalar = sum(1.0 + r for r in range(world)) / world
+r = reduce(nested, reduction="mean")
+check("reduce/0d", r["a"], (), mean_scalar)
+check("reduce/1d", r["b"][0], (3,), np.full((3,), (world - 1) / 2, np.float32))
+check("reduce/2d", r["b"][1]["c"], (2, 4))
+r = reduce(scalar, reduction="sum", scale=2.0)
+check("reduce/sum-scale", r, (), 2.0 * sum(1.0 + i for i in range(world)))
+
+# --- broadcast: every rank ends with rank0's value, original shapes ---------
+b = broadcast({"s": scalar, "v": vec + rank}, from_process=0)
+check("broadcast/0d", b["s"], (), 1.0)
+check("broadcast/1d", b["v"], (3,), np.zeros((3,), np.float32))
+
+# --- gather: 0-d leaves become (world,), n-d concatenate on dim 0 -----------
+g = gather({"s": scalar, "v": vec, "m": mat})
+check("gather/0d", g["s"], (world,), np.arange(1, world + 1, dtype=np.float32))
+check("gather/1d", g["v"], (3 * world,))
+check("gather/2d", g["m"], (2 * world, 4))
+
+# --- pad_across_processes: uneven dim padded to the max ---------------------
+uneven = np.ones((rank + 1, 2), np.float32)
+p = pad_across_processes(uneven, dim=0, pad_index=0)
+check("pad/shape", p, (world, 2))
+assert np.all(np.asarray(p)[: rank + 1] == 1.0) and np.all(np.asarray(p)[rank + 1:] == 0.0)
+
+# --- object channel ---------------------------------------------------------
+objs = gather_object([{"rank": rank}])
+assert [o["rank"] for o in objs] == list(range(world)), objs
+lst = broadcast_object_list([rank, "x" * (rank + 1)], from_process=world - 1)
+assert lst == [world - 1, "x" * world], lst
+
+# --- to_global_host: global (non-fully-addressable) 0-d and 2-d arrays ------
+sharding = NamedSharding(acc.mesh, P())
+g0 = jax.device_put(jnp.asarray(3.25, jnp.float32), sharding)
+g2 = jax.device_put(jnp.arange(8, dtype=jnp.float32).reshape(2, 4), sharding)
+h = to_global_host({"g0": g0, "g2": g2})
+check("to_global_host/0d", h["g0"], (), 3.25)
+check("to_global_host/2d", h["g2"], (2, 4), np.arange(8, dtype=np.float32).reshape(2, 4))
+
+if acc.is_main_process:
+    print("TEST_OPS OK")

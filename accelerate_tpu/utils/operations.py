@@ -323,11 +323,13 @@ def broadcast(tensor, from_process: int = 0):
     from jax.experimental import multihost_utils
 
     def _bcast(t):
-        return np.asarray(
+        arr = np.asarray(t)
+        out = np.asarray(
             multihost_utils.broadcast_one_to_all(
-                np.asarray(t), is_source=_partial_state().process_index == from_process
+                arr, is_source=_partial_state().process_index == from_process
             )
         )
+        return out.reshape(arr.shape)  # 0-d leaves must stay 0-d
 
     return recursively_apply(_bcast, tensor)
 
@@ -370,16 +372,18 @@ def to_global_host(tree):
     get_state_dict, accelerator.py:4002-4072)."""
 
     def _fetch(t):
+        # np.asarray of a TPU array can expose the device's tiled layout as a
+        # strided view; downstream writers (safetensors, memmap, ctypes)
+        # assume C order, so normalize here at the host boundary. Reshape
+        # AFTER ascontiguousarray: it promotes 0-d arrays to 1-d, which is how
+        # round 1's LocalSGD corrupted scalar params to shape (1,).
         if isinstance(t, jax.Array) and not t.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.ascontiguousarray(
-                np.asarray(multihost_utils.process_allgather(t, tiled=True))
-            )
-        # np.asarray of a TPU array can expose the device's tiled layout as a
-        # strided view; downstream writers (safetensors, memmap, ctypes)
-        # assume C order, so normalize here at the host boundary.
-        return np.ascontiguousarray(np.asarray(t))
+            out = np.asarray(multihost_utils.process_allgather(t, tiled=True))
+            return np.ascontiguousarray(out).reshape(t.shape)
+        arr = np.asarray(t)
+        return np.ascontiguousarray(arr).reshape(arr.shape)
 
     return recursively_apply(_fetch, tree)
 
@@ -399,7 +403,10 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
         arr = np.asarray(t)
         if _world() > 1:
             stacked = _process_allgather(arr, tiled=False)
-            arr = np.sum(np.asarray(stacked), axis=0)
+            # stack axis 0 is the process axis; summing it must restore the
+            # input shape exactly (0-d leaves included — process_allgather
+            # promotes scalars, see test_utils/scripts/test_ops.py).
+            arr = np.sum(np.asarray(stacked).reshape((_world(),) + arr.shape), axis=0)
             if reduction == "mean":
                 arr = arr / _world()
         return jnp.asarray(arr * scale)

@@ -111,3 +111,19 @@ def test_convert_bytes_parse_bytes():
     assert parse_bytes("5GB") == 5 * 10**9
     assert parse_bytes("1KiB") == 1024
     assert "KB" in convert_bytes(2048)
+
+
+@pytest.mark.slow
+def test_ops_multiprocess_shape_preservation():
+    """Launched 2-process run of the test_ops assertion script (reference:
+    test_utils/scripts/test_ops.py) — 0-d/1-d/nested leaves keep their shapes
+    through reduce/broadcast/gather/pad/to_global_host."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_ops"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
+    assert "TEST_OPS OK" in out
