@@ -83,9 +83,20 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
         raise RuntimeError("Nothing prepared; call accelerator.prepare(...) first.")
 
     # Model params → name-keyed safetensors (fp32 masters, gathered to host).
+    # fsdp_plugin.state_dict_type picks the file layout (reference:
+    # FULL_STATE_DICT = one file, SHARDED_STATE_DICT = size-split shards +
+    # index, utils/fsdp_utils.py:103-337); both are name-keyed and
+    # reshard-safe, so either loads into any mesh.
+    plugin = getattr(accelerator, "fsdp_plugin", None)
+    max_shard = (
+        "5GB" if plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT" else 10**15
+    )
     params_host = to_global_host(state.params)
     if accelerator.is_main_process:
-        save_sharded_safetensors(flatten_state_dict(params_host), output_dir, weights_name=f"{MODEL_NAME}.safetensors")
+        save_sharded_safetensors(
+            flatten_state_dict(params_host), output_dir,
+            max_shard_size=max_shard, weights_name=f"{MODEL_NAME}.safetensors",
+        )
 
     # Optimizer state: flattened name-keyed arrays + treedef-free aux.
     opt_host = jax.tree.map(

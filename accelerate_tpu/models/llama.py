@@ -22,6 +22,7 @@ its benchmarks (BASELINE.json: FSDP2 Llama-7B tokens/sec/chip). Design points:
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -48,7 +49,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # compute dtype (params stay fp32 masters)
     scan_layers: bool = True
     remat: bool = False
-    attention_impl: str = "native"      # native | flash | ring | ulysses
+    # flash = Pallas fused kernel on TPU (blockwise scan fallback off-TPU);
+    # native = materialized O(S²) softmax, kept for parity tests.
+    attention_impl: str = "flash"       # flash | native | ring | ulysses
     fp8: bool = False                   # fp8 (QDQ) matmuls in MLP/attention projections
     fp8_format: str = "HYBRID"          # E4M3 | E5M2 | HYBRID (e4m3 fwd / e5m2 bwd)
 
@@ -146,9 +149,9 @@ def _dispatch_attention(impl: str):
     if impl in ("native",):
         return naive_attention
     if impl == "flash":
-        from ..ops.flash_attention import flash_attention
+        from ..ops.flash_attention import auto_flash_attention
 
-        return flash_attention
+        return auto_flash_attention
     if impl == "ring":
         from ..parallel.cp import ring_attention
 
@@ -240,10 +243,24 @@ class LlamaModel(nn.Module):
         )(input_ids)
         positions = jnp.arange(input_ids.shape[-1])[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, input_ids.shape)
+        # Selective remat: with the flash kernel the attention residuals
+        # (out, lse) are O(S), so save exactly those and recompute the rest —
+        # the backward reuses the kernel outputs instead of re-running the
+        # forward kernel. (With native attention there is nothing cheap to
+        # save; plain full-block remat applies.)
+        remat_kwargs = {"prevent_cse": False}
+        if (
+            cfg.remat
+            and cfg.attention_impl != "native"
+            and os.environ.get("ACCELERATE_FLASH_REMAT_POLICY", "1") != "0"
+        ):
+            remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            )
         if cfg.scan_layers:
             block = _ScannedBlock
             if cfg.remat:
-                block = nn.remat(block, prevent_cse=False)
+                block = nn.remat(block, **remat_kwargs)
             scanned = nn.scan(
                 block,
                 variable_axes={"params": 0},
@@ -256,7 +273,7 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 blk = LlamaBlock
                 if cfg.remat:
-                    blk = nn.remat(blk, prevent_cse=False)
+                    blk = nn.remat(blk, **remat_kwargs)
                 x = blk(cfg, name=f"layers_{i}")(x, positions)
         return RMSNorm(cfg.rms_norm_eps, name="norm")(x)
 

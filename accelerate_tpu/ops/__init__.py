@@ -1,4 +1,4 @@
-from .flash_attention import blockwise_attention, flash_attention
+from .flash_attention import auto_flash_attention, blockwise_attention, flash_attention
 from .fp8 import (
     dequantize_params_fp8,
     fp8_dot_general,

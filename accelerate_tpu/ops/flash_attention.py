@@ -119,34 +119,85 @@ def attention_stats(q, k, v, *, causal=True, q_offset=0, k_offset=0):
     return acc, m, l
 
 
-def merge_attention_stats(stats_a, stats_b):
-    """Combine two online-softmax partials over disjoint key sets."""
-    acc_a, m_a, l_a = stats_a
-    acc_b, m_b, l_b = stats_b
-    m = jnp.maximum(m_a, m_b)
-    ca = jnp.exp(m_a - m)
-    cb = jnp.exp(m_b - m)
-    return acc_a * ca[..., None] + acc_b * cb[..., None], m, l_a * ca + l_b * cb
+def flash_attention(q, k, v, *, causal: bool = True, q_offset=0, k_offset=0,
+                    block_q: int = 512, block_k: int = 512, interpret=None):
+    """Fused attention kernel dispatcher. Uses the Pallas TPU kernel
+    (ops/pallas_flash.py) on real TPU backends, the blockwise jnp path
+    elsewhere (CPU CI); logs once on fallback — never silently.
+
+    The Pallas call is a Mosaic custom call with no GSPMD partitioning rule:
+    call this either on a single device, or from inside a ``shard_map``
+    (parallel/cp.py, parallel/sp.py). Model code in the *global* SPMD program
+    should use :func:`auto_flash_attention`, which adds the shard_map."""
+    from .pallas_flash import default_interpret, pallas_flash_attention
+
+    if not default_interpret():
+        return pallas_flash_attention(
+            q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    _warn_fallback_once()
+    return blockwise_attention(
+        q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset, block_k=block_k
+    )
 
 
-def finalize_attention_stats(stats, dtype):
-    acc, m, l = stats
-    out = acc / jnp.maximum(l[..., None], 1e-30)
-    return out.transpose(0, 2, 1, 3).astype(dtype)
-
-
-def flash_attention(q, k, v, *, causal: bool = True, block_k: int = 512, **kwargs):
-    """Fused attention entry point. Uses the Pallas TPU kernel on real TPU
-    backends, the blockwise jnp path elsewhere."""
+def _inside_manual_context() -> bool:
+    """True inside shard_map (mesh axes bound manually)."""
     try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        platform = "cpu"
-    if platform in ("tpu", "axon"):
-        try:
-            from .pallas_flash import pallas_flash_attention
+        from jax._src import core as _core
 
-            return pallas_flash_attention(q, k, v, causal=causal)
-        except Exception:
-            pass
-    return blockwise_attention(q, k, v, causal=causal, block_k=block_k, **kwargs)
+        return bool(_core.get_axis_env().axis_sizes)
+    except Exception:
+        return False
+
+
+def auto_flash_attention(q, k, v, *, causal: bool = True, mesh=None):
+    """Model-layer fused attention: wraps :func:`flash_attention` in a
+    ``shard_map`` over the (dp × tp) mesh axes when a multi-device mesh is
+    active, because GSPMD cannot partition a Mosaic custom call. Degenerates
+    to the plain dispatcher on one device, on CPU (blockwise partitions fine
+    under GSPMD), or when already inside a manual context (pp/cp/sp)."""
+    from .pallas_flash import default_interpret
+
+    if default_interpret() or _inside_manual_context():
+        return flash_attention(q, k, v, causal=causal)
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        state = AcceleratorState()
+        mesh = getattr(state, "mesh", None)
+    if mesh is None or mesh.size == 1:
+        return flash_attention(q, k, v, causal=causal)
+
+    from jax.sharding import PartitionSpec as P
+
+    dp_cap = mesh.shape.get("dp_replicate", 1) * mesh.shape.get("dp_shard", 1)
+    if q.shape[0] % dp_cap != 0:
+        # shard_map needs even splits; GSPMD handles ragged batches for the
+        # blockwise path, so small/uneven batches (e.g. bs-2 eval on a pod)
+        # take that route instead of crashing.
+        _warn_fallback_once()
+        return blockwise_attention(q, k, v, causal=causal)
+
+    tp = mesh.shape.get("tp", 1)
+    # Heads shard over tp only when BOTH q and kv head counts divide: the
+    # kernel's GQA group mapping assumes q and kv heads are split together.
+    heads = "tp" if tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None
+    q_spec = P(("dp_replicate", "dp_shard"), None, heads, None)
+    kv_spec = P(("dp_replicate", "dp_shard"), None, heads, None)
+    fn = functools.partial(flash_attention, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+@functools.lru_cache(maxsize=1)
+def _warn_fallback_once():
+    import logging
+
+    logging.getLogger(__name__).info(
+        "flash_attention: no TPU backend attached — using the blockwise jnp "
+        "fallback (memory-efficient but unfused)."
+    )

@@ -22,11 +22,31 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.flash_attention import (
-    attention_stats,
-    finalize_attention_stats,
-    merge_attention_stats,
+from ..ops.flash_attention import attention_stats, auto_flash_attention
+from ..ops.pallas_flash import (
+    default_interpret,
+    merge_flash_chunks,
+    pallas_flash_attention_with_lse,
 )
+
+
+def _chunk_attention_with_lse(q_c, k_c, v_c, *, causal, q_offset, k_offset):
+    """One KV-chunk attention returning (out (B,S,H,D), lse (B,H,S)).
+
+    Pallas fused kernel on TPU (offsets ride scalar prefetch); the
+    attention_stats jnp path elsewhere. Both are exact online-softmax partials
+    that :func:`merge_flash_chunks` combines across ring rotations.
+    """
+    if not default_interpret():
+        return pallas_flash_attention_with_lse(
+            q_c, k_c, v_c, causal=causal, q_offset=q_offset, k_offset=k_offset
+        )
+    acc, m, l = attention_stats(
+        q_c, k_c, v_c, causal=causal, q_offset=q_offset, k_offset=k_offset
+    )
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)  # (B, S, H, D) f32
+    return out, m + jnp.log(l_safe)
 
 
 def _mesh_and_cfg():
@@ -58,8 +78,9 @@ def ring_attention(
         rotate_method = getattr(cfg, "cp_rotate_method", None) or "alltoall"
     cp = mesh.shape[axis_name]
     if cp == 1:
-        stats = attention_stats(q, k, v, causal=causal)
-        return finalize_attention_stats(stats, q.dtype)
+        # Global (non-manual) context: auto_flash_attention adds the
+        # shard_map a Mosaic kernel needs under a multi-device mesh.
+        return auto_flash_attention(q, k, v, causal=causal, mesh=mesh)
 
     # Manual SPMD region: batch over dp axes, seq over cp, heads over tp/sp.
     qkv_spec = P(("dp_replicate", "dp_shard"), axis_name, "tp", None)
@@ -72,38 +93,38 @@ def ring_attention(
         if rotate_method == "allgather":
             k_all = jax.lax.all_gather(k_c, axis_name, axis=1, tiled=True)
             v_all = jax.lax.all_gather(v_c, axis_name, axis=1, tiled=True)
-            stats = attention_stats(q_c, k_all, v_all, causal=causal, q_offset=q_off, k_offset=0)
-            return finalize_attention_stats(stats, q_c.dtype)
+            out, _ = _chunk_attention_with_lse(
+                q_c, k_all, v_all, causal=causal, q_offset=q_off, k_offset=0
+            )
+            return out.astype(q_c.dtype)
 
         # Ring: hold q, rotate kv. After ``step`` rotations this device holds
-        # the kv chunk originally owned by (idx - step) % cp.
+        # the kv chunk originally owned by (idx - step) % cp. Chunk partials
+        # (out, lse) merge exactly via logsumexp weights; XLA overlaps the
+        # ppermute of the next chunk with the current chunk's kernel.
         def one_step(step, carry):
-            stats, k_cur, v_cur = carry
+            out, lse, k_cur, v_cur = carry
             src = (idx - step) % cp
-            new = attention_stats(
-                q_c, k_cur, v_cur, causal=causal, q_offset=q_off, k_offset=src * s_local
+            o_i, lse_i = _chunk_attention_with_lse(
+                q_c, k_cur, v_cur, causal=causal, q_offset=q_off,
+                k_offset=src * s_local,
             )
-            stats = merge_attention_stats(stats, new)
+            out, lse = merge_flash_chunks(out, lse, o_i.astype(jnp.float32), lse_i)
             perm = [(i, (i + 1) % cp) for i in range(cp)]
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-            return stats, k_nxt, v_nxt
+            return out, lse, k_nxt, v_nxt
 
         b, s, h, d = q_c.shape
-        init = (
-            (
-                jnp.zeros((b, h, s, d), jnp.float32),
-                jnp.full((b, h, s), -1e30, jnp.float32),
-                jnp.zeros((b, h, s), jnp.float32),
-            ),
+        carry = (
+            jnp.zeros((b, s, h, d), jnp.float32),
+            jnp.full((b, h, s), -1e30, jnp.float32),
             k_c,
             v_c,
         )
-        carry = init
         for step in range(cp):  # cp is static & small: unrolled ring
             carry = one_step(step, carry)
-        stats, _, _ = carry
-        return finalize_attention_stats(stats, q_c.dtype)
+        return carry[0].astype(q_c.dtype)
 
     shard = jax.shard_map(
         _local,

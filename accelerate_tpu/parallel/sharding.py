@@ -125,17 +125,26 @@ def plan_parameter_sharding(
     parallelism_config=None,
     tp_rules: Optional[list[tuple[str, PartitionSpec]]] = None,
     min_size_to_shard: Optional[int] = None,
+    shards_params_override: Optional[bool] = None,
 ) -> Any:
     """Return a pytree of :class:`NamedSharding` matching ``params``.
 
     Precedence per leaf: explicit TP rule (regex on the "/"-joined param path)
     → FSDP policy → replicated. TP rules compose with FSDP: a TP'd dim stays
-    TP'd and FSDP shards a *different* dim when one divides evenly."""
+    TP'd and FSDP shards a *different* dim when one divides evenly.
+
+    ``shards_params_override`` forces the shard-over-dp_shard decision
+    regardless of the plugin's strategy — ZeRO-1/2 (SHARD_GRAD_OP) uses it to
+    plan *optimizer-state* shardings while the params themselves stay
+    replicated. Plugin ``ignored_params`` regexes always win (replicated)."""
     from ..parallelism_config import ParallelismConfig
     from ..utils.dataclasses import FullyShardedDataParallelPlugin
 
     cfg = parallelism_config or ParallelismConfig()
     tp_rules = tp_rules or []
+    ignored_res = [
+        re.compile(p) for p in (getattr(fsdp_plugin, "ignored_params", None) or [])
+    ]
     # Pipeline stages: stacked scanned-layer weights (leading dim = layer) are
     # sharded over ``pp`` so each stage holds its contiguous L/pp layers
     # locally (parallel/pp.py hands shard_map exactly that slice). The mesh is
@@ -151,6 +160,9 @@ def plan_parameter_sharding(
         # dp_shard axis active without an explicit plugin → FULL_SHARD default.
         shards_params = True
         fsdp_axes = tuple(ax for ax in cfg.fsdp_axes if mesh.shape[ax] > 1)
+    if shards_params_override is not None:
+        shards_params = shards_params_override
+        fsdp_axes = tuple(ax for ax in cfg.fsdp_axes if mesh.shape[ax] > 1)
     if min_size_to_shard is None:
         min_size_to_shard = (
             fsdp_plugin.min_weight_size_to_shard if fsdp_plugin is not None else 2**11
@@ -160,6 +172,10 @@ def plan_parameter_sharding(
         if leaf is None or not hasattr(leaf, "shape"):
             return replicated(mesh)
         name = _path_to_name(path)
+        if any(r.search(name) for r in ignored_res):
+            # Reference: FSDP ignored_modules/params stay unsharded
+            # (utils/dataclasses.py:1584-2190).
+            return replicated(mesh)
         spec_entries: list = [None] * len(leaf.shape)
         matched_tp = False
         for pattern, spec in tp_rules:
@@ -208,10 +224,23 @@ def plan_parameter_sharding(
     return jax.tree_util.tree_map_with_path(_spec_for, params)
 
 
-def infer_opt_state_sharding(opt_state_shapes: Any, params: Any, param_shardings: Any, mesh: Mesh) -> Any:
+def infer_opt_state_sharding(
+    opt_state_shapes: Any,
+    params: Any,
+    param_shardings: Any,
+    mesh: Mesh,
+    *,
+    memory_kind: Optional[str] = None,
+) -> Any:
     """Sharding for optimizer state: any leaf whose shape matches a param's
-    inherits that param's sharding (Adam moments etc. — ZeRO-1/2 sharded
-    optimizer state); everything else (counts, scalars) is replicated.
+    inherits that param's sharding from ``param_shardings`` (Adam moments etc.);
+    everything else (counts, scalars) is replicated.
+
+    ZeRO-1/2 passes a *sharded* plan here while the params themselves stay
+    replicated (see Accelerator._prepare_state). ``memory_kind`` pins the
+    params-shaped leaves to another memory space — ``"pinned_host"`` is the
+    TPU-native FSDP ``cpu_offload`` (the XLA host-offload path replaces the
+    reference's CPUOffload wrapper).
 
     Leaf matching is structural: optax states embed params-shaped subtrees
     (``ScaleByAdamState.mu/nu``), so we walk the state tree and pattern-match
@@ -220,6 +249,8 @@ def infer_opt_state_sharding(opt_state_shapes: Any, params: Any, param_shardings
     sharding_leaves = jax.tree_util.tree_leaves(
         param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
     )
+    if memory_kind is not None:
+        sharding_leaves = [s.with_memory_kind(memory_kind) for s in sharding_leaves]
     param_treedef = jax.tree_util.tree_structure(params)
 
     def _shard_state_leaf(leaf):

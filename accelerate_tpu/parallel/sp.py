@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.flash_attention import blockwise_attention, _repeat_kv
+from ..ops.flash_attention import auto_flash_attention, flash_attention, _repeat_kv
 
 
 def _mesh():
@@ -40,7 +40,7 @@ def ulysses_attention(
         mesh = _mesh()
     sp = mesh.shape[axis_name]
     if sp == 1:
-        return blockwise_attention(q, k, v, causal=causal)
+        return auto_flash_attention(q, k, v, causal=causal, mesh=mesh)
 
     hq = q.shape[2]
     if hq % sp != 0:
@@ -59,7 +59,7 @@ def ulysses_attention(
             return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
         qh, kh, vh = seq_to_heads(q_c), seq_to_heads(k_c), seq_to_heads(v_c)
-        out = blockwise_attention(qh, kh, vh, causal=causal)
+        out = flash_attention(qh, kh, vh, causal=causal)
         return heads_to_seq(out)
 
     shard = jax.shard_map(

@@ -5,8 +5,16 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Measures tokens/sec/chip for an FSDP-prepared Llama decoder train step in bf16
 (the BASELINE.json headline: FSDP2 Llama tokens/sec/chip, target ≥45% MFU).
 ``vs_baseline`` reports achieved_MFU / 0.45 — ≥1.0 means the MFU target is met.
-Model size auto-scales down when HBM is small (CPU fallback uses the tiny
-config so the script always completes).
+
+Timing notes (hard-won): the axon remote runtime's ``block_until_ready`` does
+not actually block, and the first post-warmup step pays a second compile
+(donated-buffer layout), so the loop warms up twice and the barrier is a host
+fetch of the final loss — which transitively waits on every chained step.
+
+Attention runs the Pallas flash kernel (ops/pallas_flash.py) with the
+selective remat policy that saves the kernel's O(S) residuals and recomputes
+only the MLP — measured 46.9k tok/s/chip (MFU 0.573) vs 24.7k (MFU 0.302) for
+naive attention under plain remat on the same 334M model.
 """
 
 import json
@@ -15,13 +23,13 @@ import time
 import numpy as np
 
 
-def _pick_config(platform: str):
+def _pick_config(platform: str, seq: int):
     import jax.numpy as jnp
 
     from accelerate_tpu.models import LlamaConfig
 
     if platform in ("tpu", "axon"):
-        # ~410M params: fits one v5e chip (16GB HBM) with Adam fp32 states.
+        # ~334M params: fits one v5e chip (16GB HBM) with Adam fp32 states.
         return (
             LlamaConfig(
                 vocab_size=32000,
@@ -30,28 +38,32 @@ def _pick_config(platform: str):
                 num_hidden_layers=16,
                 num_attention_heads=8,
                 num_key_value_heads=8,
-                max_position_embeddings=2048,
+                max_position_embeddings=seq,
                 dtype=jnp.bfloat16,
                 remat=True,
+                attention_impl="flash",
             ),
-            8,     # batch
-            2048,  # seq
+            8 if seq <= 2048 else 2,  # batch
         )
-    return LlamaConfig.tiny(dtype=jnp.bfloat16), 4, 128
+    return LlamaConfig.tiny(dtype=jnp.bfloat16), 4
 
 
-def main():
+def _measure(platform: str, seq: int, iters: int):
     import jax
-
-    platform = jax.devices()[0].platform
     import optax
 
     from accelerate_tpu import Accelerator, Model
     from accelerate_tpu.models import LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
 
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
     set_seed(0)
-    cfg, batch, seq = _pick_config(platform)
+    cfg, batch = _pick_config(platform, seq)
+    if platform not in ("tpu", "axon"):
+        seq = 128
     module = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1), dtype=np.int32)
@@ -75,33 +87,48 @@ def main():
     }
 
     state = acc.train_state
-    # Warmup/compile.
-    state, metrics = step(state, b)
-    jax.block_until_ready(metrics["loss"])
+    # Two warmups: initial compile + the donated-buffer-layout recompile.
+    for _ in range(2):
+        state, metrics = step(state, b)
+        float(np.asarray(metrics["loss"]))
 
-    iters = 20 if platform in ("tpu", "axon") else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, b)
-    jax.block_until_ready(metrics["loss"])
+    loss = float(np.asarray(metrics["loss"]))  # host fetch = the real barrier
     dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(loss), f"non-finite loss {loss}"
 
     n_devices = len(jax.devices())
-    tokens_per_step = batch * seq
-    tok_s_chip = tokens_per_step / dt / n_devices
-
+    tok_s_chip = batch * seq / dt / n_devices
     # MFU: ~6*N FLOPs/token for fwd+bwd + attention term 12*L*H*S per token.
     attn_flops_per_token = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     flops_per_token = 6 * n_params + attn_flops_per_token
     peak_flops = {"tpu": 197e12, "axon": 197e12}.get(platform, 1e12)  # v5e bf16
     mfu = tok_s_chip * flops_per_token / peak_flops
+    return tok_s_chip, mfu, n_params
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_chip = platform in ("tpu", "axon")
+    tok, mfu, n_params = _measure(platform, 2048, 30 if on_chip else 3)
+    extra = ""
+    if on_chip:
+        tok8k, mfu8k, _ = _measure(platform, 8192, 15)
+        extra = f"; seq-8192: {tok8k:.0f} tok/s/chip MFU {mfu8k:.3f}"
 
     print(
         json.dumps(
             {
                 "metric": "llama_fsdp_train_tokens_per_sec_per_chip",
-                "value": round(tok_s_chip, 1),
-                "unit": f"tokens/s/chip (bf16, {n_params/1e6:.0f}M params, seq {seq}, MFU {mfu:.3f})",
+                "value": round(tok, 1),
+                "unit": (
+                    f"tokens/s/chip (bf16, {n_params/1e6:.0f}M params, seq 2048, "
+                    f"flash+selective-remat, MFU {mfu:.3f}{extra})"
+                ),
                 "vs_baseline": round(mfu / 0.45, 3),
             }
         )
