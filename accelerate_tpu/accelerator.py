@@ -983,15 +983,32 @@ class Accelerator:
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
-        """jax.profiler trace (reference: accelerator.py:4202-4259 wraps
-        torch.profiler)."""
+        """jax.profiler trace honoring :class:`ProfileKwargs`
+        (reference: accelerator.py:4202-4259 wraps torch.profiler).
+
+        - ``schedule_option`` (wait/warmup/active/repeat/skip_first, torch
+          semantics): yields a session whose ``.step()`` you call once per
+          train step; traces cover only the active windows
+          (``<dir>/cycle_<i>``).
+        - ``profile_memory``: saves a device-memory profile next to each trace.
+        - ``on_trace_ready``: called with the session after each trace closes.
+        - ``record_shapes``/``with_stack``/``with_flops`` are inherent to XLA
+          traces (shapes, source attribution and cost analysis are always in
+          the XPlane data) — accepted for API parity.
+        """
+        from .utils.profiling import ProfileSession
+
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         trace_dir = handler.output_trace_dir or (self.project_dir or ".")
         if handler.output_trace_dir is None and self.project_dir is None:
             yield None
             return
-        with jax.profiler.trace(trace_dir):
-            yield None
+        session = ProfileSession(handler, trace_dir)
+        session.enter()
+        try:
+            yield session
+        finally:
+            session.exit()
 
     # ------------------------------------------------------------------
     # Checkpointing & model export (reference: accelerator.py:3439-3748)

@@ -119,13 +119,22 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
             with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
                 pickle.dump(scheduler.state_dict(), f)
         for i, dl in enumerate(accelerator._dataloaders):
-            sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
-            sampler = getattr(sampler, "sampler", None) or getattr(
-                getattr(dl, "batch_sampler", None), "sampler", None
-            )
-            if sampler is not None and hasattr(sampler, "state_dict"):
-                with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
-                    pickle.dump(sampler.state_dict(), f)
+            # Full loader state: sampler seed/epoch AND batches consumed this
+            # epoch, so load_state resumes mid-epoch at the exact batch
+            # (reference: dl_state_dict.bin via StatefulDataLoader,
+            # checkpointing.py:107-153).
+            if hasattr(dl, "state_dict"):
+                payload = dl.state_dict()
+            else:
+                sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
+                sampler = getattr(sampler, "sampler", None) or getattr(
+                    getattr(dl, "batch_sampler", None), "sampler", None
+                )
+                if sampler is None or not hasattr(sampler, "state_dict"):
+                    continue
+                payload = sampler.state_dict()
+            with open(os.path.join(output_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin"), "wb") as f:
+                pickle.dump(payload, f)
         for i, obj in enumerate(accelerator._custom_objects):
             with open(os.path.join(output_dir, f"custom_checkpoint_{i}.pkl"), "wb") as f:
                 pickle.dump(obj.state_dict(), f)
@@ -204,13 +213,18 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     for i, dl in enumerate(accelerator._dataloaders):
         path = os.path.join(input_dir, f"{SAMPLER_NAME}{'' if i == 0 else f'_{i}'}.bin")
         if os.path.exists(path):
-            sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
-            sampler = getattr(sampler, "sampler", None) or getattr(
-                getattr(dl, "batch_sampler", None), "sampler", None
-            )
-            if sampler is not None and hasattr(sampler, "load_state_dict"):
-                with open(path, "rb") as f:
-                    sampler.load_state_dict(pickle.load(f))
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            if hasattr(dl, "load_state_dict") and "batches_yielded" in payload:
+                # Arms mid-epoch fast-forward for the loader's next __iter__.
+                dl.load_state_dict(payload)
+            else:  # legacy checkpoint: bare sampler state
+                sampler = getattr(getattr(dl, "batch_sampler", None), "batch_sampler", None)
+                sampler = getattr(sampler, "sampler", None) or getattr(
+                    getattr(dl, "batch_sampler", None), "sampler", None
+                )
+                if sampler is not None and hasattr(sampler, "load_state_dict"):
+                    sampler.load_state_dict(payload)
     for i, obj in enumerate(accelerator._custom_objects):
         path = os.path.join(input_dir, f"custom_checkpoint_{i}.pkl")
         if os.path.exists(path):

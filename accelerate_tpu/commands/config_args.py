@@ -112,10 +112,16 @@ class LaunchConfig:
     def to_env(self) -> dict[str, str]:
         env: dict[str, str] = {
             "ACCELERATE_MIXED_PRECISION": self.mixed_precision,
-            "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
             "ACCELERATE_REMAT_POLICY": self.remat_policy,
             "ACCELERATE_SCAN_LAYERS": str(self.scan_layers).lower(),
         }
+        if self.gradient_accumulation_steps != 1:
+            # Only emit when actually configured: the env var overrides the
+            # Accelerator(gradient_accumulation_steps=...) argument in the
+            # worker, and a blanket "1" would silently cancel script settings.
+            env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(
+                self.gradient_accumulation_steps
+            )
         if self.debug:
             env["ACCELERATE_DEBUG_MODE"] = "true"
         if self.jit_cache_dir:

@@ -36,6 +36,10 @@ def _params_from_safetensors(path: str) -> tuple[int, int]:
             )
     else:
         files = [path]
+    if not files:
+        # e.g. a Hub-style dir holding only config.json — let the transformers
+        # meta-init resolver size it instead of reporting 0 params.
+        raise FileNotFoundError(f"no .safetensors files under {path}")
     total = largest = 0
     for fpath in files:
         with open(fpath, "rb") as f:

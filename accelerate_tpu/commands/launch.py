@@ -58,6 +58,15 @@ def add_launch_args(p: argparse.ArgumentParser):
     c.add_argument("--no_scan_layers", action="store_true")
     c.add_argument("--jit_cache_dir", default=None)
 
+    pod = p.add_argument_group("pod launch (ssh fan-out, reference tpu_pod_launcher)")
+    pod.add_argument("--pod_hosts", default=None,
+                     help="Comma list of ssh targets, or gcloud:NAME:ZONE — fans the "
+                          "per-host launch to every pod worker with computed ranks")
+    pod.add_argument("--pod_working_dir", default=None, help="cd here on each host first")
+    pod.add_argument("--pod_ssh_port", type=int, default=None)
+    pod.add_argument("--pod_dry_run", action="store_true",
+                     help="Print the per-host commands without running them")
+
     p.add_argument("-m", "--module", action="store_true", help="Treat the script as a python module")
     p.add_argument("training_script", help="Script (or module with -m) to launch")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments")
@@ -113,6 +122,13 @@ def _spawn(cmd, env, rank: int | None = None) -> subprocess.Popen:
 
 def launch_command(args: argparse.Namespace) -> int:
     cfg = resolve_launch_config(args)
+    if getattr(args, "pod_hosts", None):
+        from .pod import pod_launch
+
+        # Pod mode never runs the script here: each worker host re-enters
+        # `accelerate-tpu launch` with its own --machine_rank (-m rides along
+        # in the forwarded launch flags).
+        return pod_launch(args, cfg, [args.training_script, *args.training_script_args])
     base_env = {**os.environ, **cfg.to_env()}
     # Script-mode children resolve imports from the script's directory, not the
     # launcher's cwd — propagate the cwd so repo-checkout runs work uninstalled.

@@ -1,0 +1,209 @@
+"""TPU pod launch: one command on the user's workstation fans the per-host
+worker command out to every pod VM over ssh.
+
+Reference: ``tpu_pod_launcher`` + ``accelerate tpu-config``
+(commands/launch.py:1117-1173, commands/tpu.py) — there via gcloud/xla_dist;
+here a plain ssh fan-out with computed ranks. Every host runs the SAME
+``accelerate-tpu launch`` invocation plus its own ``--machine_rank``; rank 0's
+address is the JAX coordinator.
+
+Host specs:
+  --pod_hosts host1,host2,...          plain ssh targets (user@host allowed)
+  --pod_hosts gcloud:NAME:ZONE         expand via `gcloud compute tpus
+                                       tpu-vm ssh` (one call per worker)
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+
+
+def parse_pod_hosts(spec: str) -> tuple[str, list[str]]:
+    """Returns ("ssh", hosts) or ("gcloud", [name, zone])."""
+    if spec.startswith("gcloud:"):
+        _, name, zone = spec.split(":", 2)
+        return "gcloud", [name, zone]
+    hosts = [h.strip() for h in spec.split(",") if h.strip()]
+    if not hosts:
+        raise ValueError(f"no hosts in --pod_hosts {spec!r}")
+    return "ssh", hosts
+
+
+def build_pod_commands(
+    hosts: list[str],
+    script_cmd: list[str],
+    *,
+    num_processes: int | None = None,
+    main_process_ip: str | None = None,
+    main_process_port: int = 8476,
+    working_dir: str | None = None,
+    ssh_port: int | None = None,
+    env: dict | None = None,
+    launch_flags: list[str] | None = None,
+) -> list[tuple[str, list[str]]]:
+    """One (host, argv) pair per pod worker.
+
+    The remote command re-enters ``accelerate-tpu launch`` on each host with
+    ``--machine_rank i`` and the coordinator address, so the per-worker env
+    contract (ACCELERATE_COORDINATOR_ADDRESS etc.) is computed by the same
+    code path a manual per-host launch uses.
+    """
+    n = len(hosts)
+    num_processes = num_processes or n
+    coordinator = main_process_ip or hosts[0].split("@")[-1]
+    cmds = []
+    for rank, host in enumerate(hosts):
+        remote = []
+        if working_dir:
+            remote += [f"cd {shlex.quote(working_dir)} &&"]
+        for k, v in (env or {}).items():
+            remote += [f"export {k}={shlex.quote(str(v))};"]
+        remote += [
+            "accelerate-tpu", "launch",
+            f"--num_processes={num_processes}",
+            f"--num_machines={n}",
+            f"--machine_rank={rank}",
+            f"--main_process_ip={coordinator}",
+            f"--main_process_port={main_process_port}",
+        ]
+        remote += launch_flags or []
+        remote += [shlex.quote(a) for a in script_cmd]
+        ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh += ["-p", str(ssh_port)]
+        cmds.append((host, ssh + [host, " ".join(remote)]))
+    return cmds
+
+
+def build_gcloud_commands(
+    name: str,
+    zone: str,
+    num_workers: int,
+    script_cmd: list[str],
+    *,
+    launch_flags: list[str] | None = None,
+    working_dir: str | None = None,
+) -> list[tuple[str, list[str]]]:
+    """gcloud tpu-vm ssh variant: worker i addressed via --worker=i; ranks and
+    the coordinator are resolved on-VM from the TPU metadata by jax, so only
+    machine count/rank flags ride along."""
+    cmds = []
+    for rank in range(num_workers):
+        remote = []
+        if working_dir:
+            remote += [f"cd {shlex.quote(working_dir)} &&"]
+        remote += [
+            "accelerate-tpu", "launch",
+            f"--num_machines={num_workers}",
+            f"--machine_rank={rank}",
+        ]
+        remote += launch_flags or []
+        remote += [shlex.quote(a) for a in script_cmd]
+        cmds.append(
+            (
+                f"{name}[{rank}]",
+                [
+                    "gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
+                    f"--zone={zone}", f"--worker={rank}",
+                    "--command", " ".join(remote),
+                ],
+            )
+        )
+    return cmds
+
+
+def pod_launch(args, cfg, script_cmd: list[str]) -> int:
+    """Fan the launch out to every pod host; fail fast on any worker.
+
+    EVERY launch-configuration flag must be forwarded — a dropped flag means
+    workers silently train with a different config than the operator asked
+    for."""
+    kind, parsed = parse_pod_hosts(args.pod_hosts)
+    launch_flags = []
+    if cfg.mixed_precision and cfg.mixed_precision != "no":
+        launch_flags.append(f"--mixed_precision={cfg.mixed_precision}")
+    for ax in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
+        v = getattr(args, f"{ax}_size", None)
+        if v:
+            launch_flags.append(f"--{ax}_size={v}")
+    if getattr(args, "gradient_accumulation_steps", None):
+        launch_flags.append(
+            f"--gradient_accumulation_steps={args.gradient_accumulation_steps}"
+        )
+    if getattr(args, "use_fsdp", None):
+        launch_flags.append("--use_fsdp")
+    if getattr(args, "fsdp_sharding_strategy", None):
+        launch_flags.append(f"--fsdp_sharding_strategy={args.fsdp_sharding_strategy}")
+    if getattr(args, "fsdp_offload_params", None):
+        launch_flags.append("--fsdp_offload_params")
+    if getattr(args, "fsdp_activation_checkpointing", None):
+        launch_flags.append("--fsdp_activation_checkpointing")
+    if getattr(args, "remat_policy", None):
+        launch_flags.append(f"--remat_policy={args.remat_policy}")
+    if getattr(args, "no_scan_layers", False):
+        launch_flags.append("--no_scan_layers")
+    if getattr(args, "jit_cache_dir", None):
+        launch_flags.append(f"--jit_cache_dir={args.jit_cache_dir}")
+    if getattr(args, "debug", False):
+        launch_flags.append("--debug")
+    if getattr(args, "config_file", None):
+        launch_flags.append(f"--config_file={args.config_file}")
+    if getattr(args, "module", False):
+        launch_flags.append("-m")
+
+    if kind == "gcloud":
+        name, zone = parsed
+        n = args.num_machines or cfg.num_machines
+        if not n or n < 1:
+            raise ValueError("gcloud pod launch needs --num_machines=<pod workers>")
+        cmds = build_gcloud_commands(
+            name, zone, n, script_cmd,
+            launch_flags=launch_flags, working_dir=args.pod_working_dir,
+        )
+    else:
+        hosts = parsed
+        cmds = build_pod_commands(
+            hosts, script_cmd,
+            num_processes=cfg.num_processes if cfg.num_processes > 1 else None,
+            main_process_ip=cfg.main_process_ip,
+            main_process_port=cfg.main_process_port or 8476,
+            working_dir=args.pod_working_dir,
+            ssh_port=args.pod_ssh_port,
+            launch_flags=launch_flags,
+        )
+
+    if args.pod_dry_run:
+        for host, argv in cmds:
+            print(f"[{host}] {' '.join(argv)}")
+        return 0
+
+    procs = [(host, subprocess.Popen(argv)) for host, argv in cmds]
+    exit_code = 0
+    import signal
+    import time
+
+    try:
+        while any(p.poll() is None for _, p in procs):
+            for host, p in procs:
+                rc = p.poll()
+                if rc is not None and rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    print(
+                        f"[accelerate-tpu] pod worker {host} exited with {rc}; "
+                        "terminating the rest",
+                        file=sys.stderr,
+                    )
+                    for _, other in procs:
+                        if other.poll() is None:
+                            other.send_signal(signal.SIGTERM)
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for _, p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for _, p in procs:
+            p.wait()
+        return 130
+    return exit_code or next((p.returncode for _, p in procs if p.returncode), 0)

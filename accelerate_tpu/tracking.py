@@ -226,11 +226,242 @@ class MLflowTracker(GeneralTracker):
         mlflow.end_run()
 
 
+class TrackioTracker(GeneralTracker):
+    """(reference: tracking.py:418-494)"""
+
+    name = "trackio"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import trackio
+
+        self.run = trackio.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import trackio
+
+        trackio.config.update(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        import trackio
+
+        trackio.finish()
+
+
+class CometMLTracker(GeneralTracker):
+    """(reference: tracking.py:495-588)"""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import comet_ml
+
+        self.experiment = comet_ml.start(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.experiment
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.experiment.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.experiment.set_step(step)
+        for k, v in values.items():
+            if isinstance(v, str):
+                self.experiment.log_other(k, v)
+            elif isinstance(v, dict):
+                self.experiment.log_metrics(v, step=step, **kwargs)
+            else:
+                self.experiment.log_metric(k, v, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.experiment.end()
+
+
+class AimTracker(GeneralTracker):
+    """(reference: tracking.py:589-691)"""
+
+    name = "aim"
+    requires_logging_directory = True
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = _jsonable(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """(reference: tracking.py:901-1058)"""
+
+    name = "clearml"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        existing = Task.current_task()  # capture BEFORE init creates one
+        self.task = existing or Task.init(project_name=run_name, **kwargs)
+        self._initialized_externally = existing is not None
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        logger_ = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "item"):
+                if step is None:
+                    logger_.report_single_value(name=k, value=float(v))
+                else:
+                    # "title/series" convention mirrors the reference's split.
+                    title, _, series = k.partition("/")
+                    logger_.report_scalar(
+                        title=title, series=series or title, value=float(v),
+                        iteration=step, **kwargs,
+                    )
+
+    @on_main_process
+    def finish(self):
+        if not self._initialized_externally:
+            self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """(reference: tracking.py:1059-1146)"""
+
+    name = "dvclive"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: Optional[str] = None, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "item"):
+                self.live.log_metric(k, float(v), **kwargs)
+            else:  # strings etc. ride as params, mirroring the reference
+                self.live.log_param(k, v)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+class SwanLabTracker(GeneralTracker):
+    """(reference: tracking.py:1147-1246)"""
+
+    name = "swanlab"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import swanlab
+
+        self.run = swanlab.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import swanlab
+
+        swanlab.config.update(_jsonable(values))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        import swanlab
+
+        swanlab.finish()
+
+
 LOGGER_TYPE_TO_CLASS = {
     "json": JSONTracker,
     "tensorboard": TensorBoardTracker,
     "wandb": WandBTracker,
     "mlflow": MLflowTracker,
+    "trackio": TrackioTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+    "swanlab": SwanLabTracker,
 }
 
 _AVAILABILITY = {

@@ -1,0 +1,102 @@
+"""Schedule-driven jax.profiler sessions.
+
+The reference builds a ``torch.profiler.profile`` from ``ProfileKwargs``
+(reference: utils/dataclasses.py:486-601) with a step-based
+wait/warmup/active/repeat schedule driven by ``prof.step()``. jax.profiler is
+start/stop based; :class:`ProfileSession` reproduces the schedule on top of it
+and adds device-memory snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ProfileSession:
+    """One ``accelerator.profile()`` context.
+
+    Without ``schedule_option`` the whole context is traced. With it, call
+    :meth:`step` once per training step; the session opens a trace at each
+    active-window start and closes it after ``active`` steps, ``repeat``
+    times (0 = unlimited), skipping ``skip_first`` then cycling
+    (wait → warmup → active) — torch.profiler semantics.
+    """
+
+    def __init__(self, handler, trace_dir: str):
+        self.handler = handler
+        self.trace_dir = trace_dir
+        sched = handler.schedule_option or {}
+        self.scheduled = bool(sched)
+        self.wait = int(sched.get("wait", 0))
+        self.warmup = int(sched.get("warmup", 0))
+        self.active = int(sched.get("active", 1))
+        self.repeat = int(sched.get("repeat", 0))
+        self.skip_first = int(sched.get("skip_first", 0))
+        if self.scheduled and self.active <= 0:
+            raise ValueError("schedule_option['active'] must be >= 1")
+        self.step_num = 0
+        self.cycles_done = 0
+        self._tracing = False
+        self.trace_dirs: list[str] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def enter(self):
+        if not self.scheduled:
+            self._start(self.trace_dir)
+
+    def exit(self):
+        if self._tracing:
+            self._stop()
+
+    def step(self):
+        """Advance the schedule by one training step."""
+        if not self.scheduled:
+            return
+        self.step_num += 1
+        pos = self.step_num - self.skip_first
+        if pos <= 0:
+            return
+        cycle_len = self.wait + self.warmup + self.active
+        in_cycle = (pos - 1) % cycle_len
+        cycle_idx = (pos - 1) // cycle_len
+        if self.repeat and cycle_idx >= self.repeat:
+            if self._tracing:
+                self._stop()
+            return
+        # Trace covers the active window: [wait+warmup, wait+warmup+active).
+        # Two independent ifs: with active == 1 the start and stop land on the
+        # SAME step (an elif would merge windows and skip half the cycles).
+        if in_cycle == self.wait + self.warmup and not self._tracing:
+            self._start(os.path.join(self.trace_dir, f"cycle_{cycle_idx}"))
+        if in_cycle == cycle_len - 1 and self._tracing:
+            self._stop()
+
+    # -- internals ---------------------------------------------------------
+
+    def _start(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._current_dir = path
+        self._tracing = True
+
+    def _stop(self):
+        jax.profiler.stop_trace()
+        self._tracing = False
+        self.trace_dirs.append(self._current_dir)
+        self.cycles_done += 1
+        if self.handler.profile_memory:
+            try:
+                jax.profiler.save_device_memory_profile(
+                    os.path.join(self._current_dir, "memory.prof")
+                )
+            except Exception as e:  # memory profiling needs a live backend
+                logger.warning(f"device memory profile failed: {e}")
+        if self.handler.on_trace_ready is not None:
+            self.handler.on_trace_ready(self)

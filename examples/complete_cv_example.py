@@ -1,0 +1,115 @@
+"""Complete CV example — everything at once (reference:
+examples/complete_cv_example.py): conv-net image classification with mixed
+precision + gradient accumulation, checkpointing every N steps/epoch with
+resume, experiment tracking, and eval via gather_for_metrics.
+
+Run:
+    python examples/complete_cv_example.py --checkpointing_steps epoch \
+        --project_dir /tmp/complete_cv --with_tracking
+    python examples/complete_cv_example.py --resume_from_checkpoint \
+        /tmp/complete_cv/checkpoints/checkpoint_0 --project_dir /tmp/complete_cv
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+from cv_example import NUM_CLASSES, ConvNet, LoaderSpec, build_dataset
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="json" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir, automatic_checkpoint_naming=True, total_limit=3
+        ),
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+
+    module = ConvNet()
+    train_ds = build_dataset(1024, seed=0)
+    eval_ds = build_dataset(256, seed=1)
+    sample = train_ds[0]
+    model = Model.from_flax(module, jax.random.key(args.seed), sample["images"][None])
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr, weight_decay=1e-4),
+        LoaderSpec(train_ds, args.batch_size),
+        LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+    )
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["images"])
+        return optax.softmax_cross_entropy(
+            logits, jax.nn.one_hot(batch["labels"], NUM_CLASSES)
+        ).mean()
+
+    step_fn = accelerator.prepare_train_step(loss_fn, max_grad_norm=1.0)
+
+    starting_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        starting_epoch = int(np.asarray(accelerator.train_state.step)) // len(train_dl)
+        accelerator.print(f"Resumed from {args.resume_from_checkpoint} at epoch {starting_epoch}")
+
+    def _evaluate():
+        correct = total = 0
+        for batch in eval_dl:
+            preds = jnp.argmax(model(batch["images"]), -1)
+            g = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(g[0]) == np.asarray(g[1])).sum())
+            total += len(np.asarray(g[0]))
+        return correct / max(total, 1)
+
+    state = accelerator.train_state
+    acc_val = _evaluate() if starting_epoch >= args.epochs else 0.0
+    for epoch in range(starting_epoch, args.epochs):
+        for step, batch in enumerate(train_dl):
+            state, metrics = step_fn(state, batch)
+            if args.checkpointing_steps.isdigit() and (step + 1) % int(args.checkpointing_steps) == 0:
+                accelerator.save_state()
+        jax.block_until_ready(state.params)  # drain before eval (CPU-mesh guard)
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state()
+
+        acc_val = _evaluate()
+        accelerator.print(f"epoch {epoch}: accuracy {acc_val:.3f}")
+        if args.with_tracking:
+            accelerator.log({"accuracy": acc_val, "loss": float(metrics["loss"])}, step=epoch)
+
+    accelerator.end_training()
+    return acc_val
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--checkpointing_steps", type=str, default="epoch")
+    parser.add_argument("--resume_from_checkpoint", type=str, default=None)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", type=str, default="/tmp/accelerate_tpu_complete_cv")
+    args = parser.parse_args()
+    acc = training_function(args)
+    print(f"final_accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

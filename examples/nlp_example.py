@@ -129,6 +129,9 @@ def training_function(args):
             state, metrics = step_fn(state, batch)
             seen += args.batch_size
         accelerator._train_state = state
+        # Drain the async pipeline before eval (CPU-mesh stuck-detector guard)
+        # — also makes step_time honest.
+        jax.block_until_ready(state.params)
         step_time = (time.time() - t0) / max(1, seen // args.batch_size)
 
         # Eval with gather_for_metrics (drops duplicated tail samples).

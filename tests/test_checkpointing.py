@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 
-def _setup(tmpdir, accum=1):
+def _setup(tmpdir, accum=1, shuffle=False):
     import jax
     import optax
 
@@ -37,10 +37,13 @@ def _setup(tmpdir, accum=1):
         def __getitem__(self, i):
             return {"x": x[i], "y": y[i]}
 
+    class RandomSampler:  # name triggers shuffle inference (seedable sampler)
+        pass
+
     class Spec:
         dataset = DS()
         batch_size = 16
-        sampler = None
+        sampler = RandomSampler() if shuffle else None
         drop_last = False
 
     model = Model.from_flax(module, jax.random.key(0), x[:1])
@@ -165,3 +168,54 @@ def test_save_safetensors_noncontiguous_view():
     save_safetensors({"k": view}, path)
     back = load_safetensors(path)
     np.testing.assert_array_equal(back["k"], view)
+
+
+def test_mid_epoch_resume_matches_uninterrupted(tmp_path):
+    """Kill training mid-epoch, resume from the checkpoint, and the resumed
+    run must consume the SAME remaining batches, sample-for-sample, as the
+    uninterrupted run (VERDICT r1 item 5; reference contract:
+    checkpointing.py:107-153 + data_loader.py:416-508)."""
+    import jax
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    # --- uninterrupted: record every batch of epoch 0 + epoch 1 -------------
+    acc, model, opt, dl, loss_fn = _setup(tmp_path, shuffle=True)
+    dl.set_epoch(0)
+    full = [jax.device_get(b) for b in dl]
+    dl.set_epoch(1)
+    full += [jax.device_get(b) for b in dl]
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+    # --- interrupted: stop after 2 batches of epoch 0, save, resume ---------
+    acc2, model2, opt2, dl2, loss_fn2 = _setup(tmp_path / "b", shuffle=True)
+    dl2.set_epoch(0)
+    seen = []
+    it = iter(dl2)
+    for _ in range(2):
+        seen.append(jax.device_get(next(it)))
+    assert dl2.batches_yielded == 2
+    ckpt = acc2.save_state()
+    del it  # training "killed" mid-epoch here
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc3, model3, opt3, dl3, loss_fn3 = _setup(tmp_path / "c", shuffle=True)
+    acc3.load_state(ckpt)
+    # Resumed loader: finishes epoch 0 from batch 2, then a fresh epoch 1.
+    seen += [jax.device_get(b) for b in dl3]
+    dl3.set_epoch(1)
+    seen += [jax.device_get(b) for b in dl3]
+
+    # Sanity: the sampler really shuffles differently across epochs — the
+    # equality below is only meaningful then.
+    e0 = [np.asarray(b["x"]) for b in full[:4]]
+    e1 = [np.asarray(b["x"]) for b in full[4:8]]
+    assert not all(np.array_equal(a, c) for a, c in zip(e0, e1))
+    assert len(seen) == len(full), (len(seen), len(full))
+    for i, (a, b) in enumerate(zip(full, seen)):
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                          err_msg=f"batch {i} key {k}")

@@ -135,3 +135,98 @@ def test_notebook_launcher_single():
     from accelerate_tpu import notebook_launcher
 
     notebook_launcher(_square, (3,), num_processes=1)
+
+
+def test_pod_launch_dry_run_ssh(capsys):
+    """Pod fan-out (reference tpu_pod_launcher, commands/launch.py:1117-1173):
+    dry-run prints one ssh command per host with computed ranks and the
+    coordinator pinned to host 0."""
+    import sys
+    from unittest import mock
+
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    argv = ["accelerate-tpu", "launch",
+            "--pod_hosts", "tpu-w0,tpu-w1,tpu-w2",
+            "--pod_working_dir", "/srv/job",
+            "--pod_dry_run", "--tp_size", "4", "--mixed_precision", "bf16",
+            "train.py", "--lr", "1e-4"]
+    with mock.patch.object(sys, "argv", argv):
+        rc = main()
+    assert not rc
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+    for rank, line in enumerate(out):
+        assert line.startswith(f"[tpu-w{rank}] ssh ")
+        assert f"--machine_rank={rank}" in line
+        assert "--num_machines=3" in line
+        assert "--main_process_ip=tpu-w0" in line
+        assert "--main_process_port=8476" in line
+        assert "cd /srv/job &&" in line
+        assert "--tp_size=4" in line
+        assert "--mixed_precision=bf16" in line
+        assert "train.py --lr 1e-4" in line
+
+
+def test_pod_launch_dry_run_gcloud(capsys):
+    import sys
+    from unittest import mock
+
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    argv = ["accelerate-tpu", "launch",
+            "--pod_hosts", "gcloud:my-pod:us-central2-b",
+            "--num_machines", "2", "--pod_dry_run", "train.py"]
+    with mock.patch.object(sys, "argv", argv):
+        rc = main()
+    assert not rc
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    for rank, line in enumerate(out):
+        assert "gcloud compute tpus tpu-vm ssh my-pod" in line
+        assert f"--worker={rank}" in line
+        assert "--zone=us-central2-b" in line
+        assert f"--machine_rank={rank}" in line
+
+
+def test_estimate_memory_hub_config_meta_init(tmp_path):
+    """Hub-model sizing via transformers meta-device init (reference:
+    commands/estimate.py:66-318) — a config.json-only directory must size
+    through AutoModel.from_config on the meta device, no weights."""
+    import json as _json
+
+    from accelerate_tpu.commands.estimate import estimate_memory
+
+    cfg = {"architectures": ["LlamaForCausalLM"], "model_type": "llama",
+           "hidden_size": 256, "intermediate_size": 688, "num_hidden_layers": 2,
+           "num_attention_heads": 4, "num_key_value_heads": 4, "vocab_size": 1000,
+           "max_position_embeddings": 128}
+    (tmp_path / "config.json").write_text(_json.dumps(cfg))
+    rows = estimate_memory(str(tmp_path), ["bf16", "fp32"])
+    assert rows[0]["inference_total"] > 1_000_000  # ~2.1M params * 2 bytes
+    assert rows[0]["training_total"] > rows[0]["inference_total"]
+
+
+def test_pod_launch_forwards_all_config_flags(capsys):
+    """Every launch-config flag must reach the per-host command — a dropped
+    flag silently diverges worker configs."""
+    import sys
+    from unittest import mock
+
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    argv = ["accelerate-tpu", "launch",
+            "--pod_hosts", "h0,h1", "--pod_dry_run",
+            "--gradient_accumulation_steps", "4",
+            "--use_fsdp", "--fsdp_sharding_strategy", "SHARD_GRAD_OP",
+            "--fsdp_activation_checkpointing", "--remat_policy", "full",
+            "--no_scan_layers", "--debug", "--jit_cache_dir", "/tmp/jc",
+            "train.py"]
+    with mock.patch.object(sys, "argv", argv):
+        assert not main()
+    out = capsys.readouterr().out
+    for frag in ("--gradient_accumulation_steps=4", "--use_fsdp",
+                 "--fsdp_sharding_strategy=SHARD_GRAD_OP",
+                 "--fsdp_activation_checkpointing", "--remat_policy=full",
+                 "--no_scan_layers", "--debug", "--jit_cache_dir=/tmp/jc"):
+        assert frag in out, frag

@@ -155,3 +155,19 @@ def test_dispatcher_single_process():
     batches = list(dl)
     assert len(batches) == 2
     assert batches[0]["x"].shape == (8, 4)
+
+
+@pytest.mark.slow
+def test_dispatcher_batch_semantics_multiprocess():
+    """Launched 2-process run of test_dispatch: non-split dispatch hands every
+    rank a FULL batch_size batch (reference data_loader.py:804-944); split
+    hands batch_size/world."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_dispatch"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
+    assert "TEST_DISPATCH OK" in out

@@ -1,0 +1,238 @@
+"""Tracker registry + the 6 round-2 trackers (Trackio, CometML, Aim, ClearML,
+DVCLive, SwanLab) behind availability probes, tested against mock SDK modules
+(reference: tracking.py:418-1246, registry :1247)."""
+
+import sys
+import types
+from unittest import mock
+
+import numpy as np
+import pytest
+
+
+def test_registry_lists_all_reference_trackers():
+    from accelerate_tpu.tracking import LOGGER_TYPE_TO_CLASS
+
+    # Reference ships 9 trackers (tracking.py:1247); we add "json".
+    expected = {"json", "tensorboard", "wandb", "mlflow", "trackio", "comet_ml",
+                "aim", "clearml", "dvclive", "swanlab"}
+    assert expected <= set(LOGGER_TYPE_TO_CLASS)
+    assert len(LOGGER_TYPE_TO_CLASS) >= 10
+
+
+def test_every_tracker_has_availability_probe():
+    from accelerate_tpu.tracking import _AVAILABILITY, LOGGER_TYPE_TO_CLASS
+
+    assert set(LOGGER_TYPE_TO_CLASS) <= set(_AVAILABILITY)
+
+
+def _mock_module(name, **attrs):
+    m = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    return m
+
+
+def test_trackio_tracker_logs_via_mock():
+    from accelerate_tpu.tracking import TrackioTracker
+
+    run = mock.MagicMock()
+    mod = _mock_module("trackio", init=mock.MagicMock(return_value=run),
+                       config=mock.MagicMock(), finish=mock.MagicMock())
+    with mock.patch.dict(sys.modules, {"trackio": mod}):
+        t = TrackioTracker("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.0}, step=3)
+        t.finish()
+    mod.init.assert_called_once()
+    run.log.assert_called_once_with({"loss": 1.0}, step=3)
+    mod.finish.assert_called_once()
+
+
+def test_comet_ml_tracker_logs_via_mock():
+    from accelerate_tpu.tracking import CometMLTracker
+
+    exp = mock.MagicMock()
+    mod = _mock_module("comet_ml", start=mock.MagicMock(return_value=exp))
+    with mock.patch.dict(sys.modules, {"comet_ml": mod}):
+        t = CometMLTracker("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 2.0, "note": "hi", "nested": {"a": 1.0}}, step=5)
+        t.finish()
+    exp.log_parameters.assert_called_once_with({"lr": 0.1})
+    exp.log_metric.assert_called_once_with("loss", 2.0, step=5)
+    exp.log_other.assert_called_once_with("note", "hi")
+    exp.log_metrics.assert_called_once_with({"a": 1.0}, step=5)
+    exp.end.assert_called_once()
+
+
+def test_aim_tracker_logs_via_mock(tmp_path):
+    from accelerate_tpu.tracking import AimTracker
+
+    run = mock.MagicMock()
+    mod = _mock_module("aim", Run=mock.MagicMock(return_value=run))
+    with mock.patch.dict(sys.modules, {"aim": mod}):
+        t = AimTracker("run1", logging_dir=str(tmp_path))
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.5}, step=2)
+        t.finish()
+    mod.Run.assert_called_once_with(repo=str(tmp_path))
+    run.track.assert_called_once_with(1.5, name="loss", step=2)
+    run.close.assert_called_once()
+
+
+def test_clearml_tracker_logs_via_mock():
+    from accelerate_tpu.tracking import ClearMLTracker
+
+    task = mock.MagicMock()
+    Task = mock.MagicMock()
+    Task.current_task.return_value = None
+    Task.init.return_value = task
+    mod = _mock_module("clearml", Task=Task)
+    with mock.patch.dict(sys.modules, {"clearml": mod}):
+        t = ClearMLTracker("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"train/loss": 0.5, "acc": 0.9}, step=7)
+        t.finish()
+    logger_ = task.get_logger.return_value
+    logger_.report_scalar.assert_any_call(
+        title="train", series="loss", value=0.5, iteration=7
+    )
+    logger_.report_scalar.assert_any_call(title="acc", series="acc", value=0.9, iteration=7)
+    task.close.assert_called_once()
+
+
+def test_dvclive_tracker_logs_via_mock():
+    from accelerate_tpu.tracking import DVCLiveTracker
+
+    live = mock.MagicMock()
+    mod = _mock_module("dvclive", Live=mock.MagicMock(return_value=live))
+    with mock.patch.dict(sys.modules, {"dvclive": mod}):
+        t = DVCLiveTracker("run")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 0.25}, step=4)
+        t.finish()
+    live.log_params.assert_called_once_with({"lr": 0.1})
+    live.log_metric.assert_called_once_with("loss", 0.25)
+    assert live.step == 4
+    live.next_step.assert_called_once()
+    live.end.assert_called_once()
+
+
+def test_swanlab_tracker_logs_via_mock():
+    from accelerate_tpu.tracking import SwanLabTracker
+
+    run = mock.MagicMock()
+    mod = _mock_module("swanlab", init=mock.MagicMock(return_value=run),
+                       config=mock.MagicMock(), finish=mock.MagicMock())
+    with mock.patch.dict(sys.modules, {"swanlab": mod}):
+        t = SwanLabTracker("proj")
+        t.log({"loss": 0.1}, step=1)
+        t.finish()
+    run.log.assert_called_once_with({"loss": 0.1}, step=1)
+    mod.finish.assert_called_once()
+
+
+def test_filter_trackers_drops_unavailable(caplog):
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.tracking import filter_trackers
+
+    PartialState()  # logging requires initialized state
+    chosen = filter_trackers(["json", "comet_ml"], logging_dir="/tmp/x")
+    names = [c if isinstance(c, str) else getattr(c, "name", c) for c in chosen]
+    # comet_ml is not installed in this image → dropped with a warning.
+    assert any("json" in str(n) for n in names)
+    assert not any("comet" in str(n) for n in names)
+
+
+# ---------------------------------------------------------------------------
+# profile() honoring ProfileKwargs (VERDICT r1 weak-item 7)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_schedule_traces_active_windows(tmp_path):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProfileKwargs
+
+    ready = []
+    handler = ProfileKwargs(
+        schedule_option={"wait": 1, "warmup": 1, "active": 2, "repeat": 2},
+        output_trace_dir=str(tmp_path),
+        on_trace_ready=lambda sess: ready.append(sess.trace_dirs[-1]),
+    )
+    acc = Accelerator()
+    with acc.profile(handler) as prof:
+        for _ in range(10):
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+            prof.step()
+    assert prof.cycles_done == 2
+    assert ready == [str(tmp_path / "cycle_0"), str(tmp_path / "cycle_1")]
+    for d in ready:
+        # jax writes plugins/profile/<ts>/ under the trace dir
+        assert any("profile" in r for r, _, _ in ((r, d_, f) for r, d_, f in __import__("os").walk(d))), d
+
+
+def test_profile_unscheduled_traces_whole_context(tmp_path):
+    import os
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProfileKwargs
+
+    acc = Accelerator()
+    with acc.profile(ProfileKwargs(output_trace_dir=str(tmp_path))) as prof:
+        (jnp.ones((4, 4)) * 2).block_until_ready()
+    assert prof.trace_dirs == [str(tmp_path)]
+    assert os.path.isdir(os.path.join(str(tmp_path), "plugins"))
+
+
+def test_clearml_external_task_not_closed():
+    """When a ClearML task already exists (e.g. pipeline-managed), finish()
+    must NOT close it."""
+    from accelerate_tpu.tracking import ClearMLTracker
+
+    task = mock.MagicMock()
+    Task = mock.MagicMock()
+    Task.current_task.return_value = task  # pre-existing task
+    mod = _mock_module("clearml", Task=Task)
+    with mock.patch.dict(sys.modules, {"clearml": mod}):
+        t = ClearMLTracker("proj")
+        t.finish()
+    Task.init.assert_not_called()
+    task.close.assert_not_called()
+
+
+def test_dvclive_mixed_value_log_does_not_crash():
+    from accelerate_tpu.tracking import DVCLiveTracker
+
+    live = mock.MagicMock()
+    mod = _mock_module("dvclive", Live=mock.MagicMock(return_value=live))
+    with mock.patch.dict(sys.modules, {"dvclive": mod}):
+        t = DVCLiveTracker("run")
+        t.log({"loss": 0.25, "stage": "eval"}, step=1)
+    live.log_metric.assert_called_once_with("loss", 0.25)
+    live.log_param.assert_called_once_with("stage", "eval")
+
+
+def test_profile_schedule_active_one(tmp_path):
+    """active=1: start and stop land on the same step — every cycle must
+    still produce its own trace."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProfileKwargs
+
+    handler = ProfileKwargs(
+        schedule_option={"wait": 1, "warmup": 1, "active": 1, "repeat": 2},
+        output_trace_dir=str(tmp_path),
+    )
+    acc = Accelerator()
+    with acc.profile(handler) as prof:
+        for _ in range(8):
+            (jnp.ones((4, 4)) * 2).block_until_ready()
+            prof.step()
+    assert prof.cycles_done == 2
+    assert prof.trace_dirs == [str(tmp_path / "cycle_0"), str(tmp_path / "cycle_1")]

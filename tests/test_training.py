@@ -172,3 +172,21 @@ def test_tensor_parallel_training():
 
     losses, _ = _train(parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2), steps=4)
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_sync_semantics_multiprocess():
+    """Launched 2-process run of test_sync (reference: test_utils/scripts/
+    test_sync.py + test_distributed_data_loop.py): accumulate/no_sync update
+    gating, end-of-dataloader forced sync, even_batches vs join_uneven."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_sync"
+    ]
+    # One device per process: the script's tiny fixed batches don't divide
+    # the 8-virtual-device flag pytest's conftest exports.
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""})
+    assert "TEST_SYNC OK" in out
