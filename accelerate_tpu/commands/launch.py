@@ -58,6 +58,13 @@ def add_launch_args(p: argparse.ArgumentParser):
     c.add_argument("--no_scan_layers", action="store_true")
     c.add_argument("--jit_cache_dir", default=None)
 
+    el = p.add_argument_group("elastic restarts (reference: torch.distributed.run max_restarts)")
+    el.add_argument("--max_restarts", type=int, default=0,
+                    help="Restart the whole process gang up to N times after a "
+                         "worker failure (fresh rendezvous each attempt)")
+    el.add_argument("--monitor_interval", type=float, default=0.2,
+                    help="Seconds between worker health polls")
+
     pod = p.add_argument_group("pod launch (ssh fan-out, reference tpu_pod_launcher)")
     pod.add_argument("--pod_hosts", default=None,
                      help="Comma list of ssh targets, or gcloud:NAME:ZONE — fans the "
@@ -146,17 +153,47 @@ def launch_command(args: argparse.Namespace) -> int:
 
     if remote:
         # This invocation is ONE pod member; its peers run the same command
-        # with their own --machine_rank.
+        # with their own --machine_rank. --main_process_ip=auto defers the
+        # whole rendezvous to jax's TPU-metadata discovery (gcloud pods).
+        coord = (
+            "auto" if coordinator_ip == "auto" else f"{coordinator_ip}:{port or 8476}"
+        )
         env = {
             **base_env,
-            "ACCELERATE_COORDINATOR_ADDRESS": f"{coordinator_ip}:{port or 8476}",
+            "ACCELERATE_COORDINATOR_ADDRESS": coord,
             "ACCELERATE_NUM_PROCESSES": str(cfg.num_processes),
             "ACCELERATE_PROCESS_INDEX": str(cfg.machine_rank),
             "ACCELERATE_LOCAL_PROCESS_INDEX": "0",
         }
         return subprocess.call(cmd, env=env)
 
-    # Local fan-out: all processes on this machine.
+    # Local fan-out: all processes on this machine. The whole gang restarts
+    # together up to --max_restarts times after any worker failure (the
+    # reference delegates this to torch elastic's max_restarts,
+    # commands/launch.py:998-1030); each attempt gets a fresh rendezvous port
+    # so stale coordinator state can't poison the retry.
+    max_restarts = int(getattr(args, "max_restarts", 0) or 0)
+    monitor_interval = float(getattr(args, "monitor_interval", 0.2) or 0.2)
+    for attempt in range(max_restarts + 1):
+        rc = _run_gang(cmd, base_env, cfg, port, monitor_interval, attempt)
+        if rc in (0, 130):
+            return rc
+        if attempt < max_restarts:
+            print(
+                f"[accelerate-tpu] attempt {attempt} failed (rc={rc}); "
+                f"restarting gang ({max_restarts - attempt} restarts left)",
+                file=sys.stderr,
+            )
+            port = None  # re-draw a fresh port next attempt
+    return rc
+
+
+def _run_gang(cmd, base_env, cfg, port, monitor_interval: float, attempt: int) -> int:
+    """One launch attempt of the full process gang; fail fast on ANY rank's
+    crash (not just rank 0's) so a dead peer doesn't leave siblings blocked in
+    coordinator rendezvous until their own timeout."""
+    import time
+
     if port is None:
         from ..utils.other import get_free_port
 
@@ -170,13 +207,9 @@ def launch_command(args: argparse.Namespace) -> int:
                 "ACCELERATE_NUM_PROCESSES": str(cfg.num_processes),
                 "ACCELERATE_PROCESS_INDEX": str(rank),
                 "ACCELERATE_LOCAL_PROCESS_INDEX": str(rank),
+                "ACCELERATE_RESTART_ATTEMPT": str(attempt),
             }
             procs.append(_spawn(cmd, env, rank))
-        # Fail fast on ANY rank's crash (not just rank 0's): poll all children
-        # so a dead peer doesn't leave siblings blocked in coordinator
-        # rendezvous until their own timeout.
-        import time
-
         exit_code = 0
         while any(p.poll() is None for p in procs):
             for rank, proc in enumerate(procs):
@@ -191,7 +224,7 @@ def launch_command(args: argparse.Namespace) -> int:
                     for other in procs:
                         if other.poll() is None:
                             other.send_signal(signal.SIGTERM)
-            time.sleep(0.2)
+            time.sleep(monitor_interval)
         if exit_code == 0:
             exit_code = next((p.returncode for p in procs if p.returncode != 0), 0)
         return exit_code

@@ -86,9 +86,10 @@ def build_gcloud_commands(
     launch_flags: list[str] | None = None,
     working_dir: str | None = None,
 ) -> list[tuple[str, list[str]]]:
-    """gcloud tpu-vm ssh variant: worker i addressed via --worker=i; ranks and
-    the coordinator are resolved on-VM from the TPU metadata by jax, so only
-    machine count/rank flags ride along."""
+    """gcloud tpu-vm ssh variant: worker i addressed via --worker=i.
+    ``--main_process_ip=auto`` makes each worker's launch defer rendezvous to
+    jax's TPU-metadata discovery (jax.distributed.initialize() with no args)
+    instead of pointing at a literal coordinator address."""
     cmds = []
     for rank in range(num_workers):
         remote = []
@@ -98,6 +99,7 @@ def build_gcloud_commands(
             "accelerate-tpu", "launch",
             f"--num_machines={num_workers}",
             f"--machine_rank={rank}",
+            "--main_process_ip=auto",
         ]
         remote += launch_flags or []
         remote += [shlex.quote(a) for a in script_cmd]

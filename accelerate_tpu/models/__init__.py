@@ -27,3 +27,11 @@ from .moe import (
     mixtral_tp_rules,
     moe_cross_entropy_loss,
 )
+from .t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    T5Stack,
+    shift_tokens_right,
+    t5_cross_entropy_loss,
+    t5_tp_rules,
+)

@@ -1,0 +1,331 @@
+"""T5-family encoder-decoder, TPU-first.
+
+The reference reaches T5 only through the Megatron-LM engine
+(reference: utils/megatron_lm.py:640-760 ``T5TrainStep`` + model provider);
+here it is a native flax family with the same design points as
+models/llama.py / models/bert.py: MXU-shaped fused head projections, optional
+``nn.scan`` over identical blocks, optional remat, a Megatron-style
+column/row TP rule table.
+
+Architecture follows T5 v1.0: relative-position-bias attention (bucketed,
+shared from the first layer of each stack), pre-RMSNorm blocks, ReLU FFN,
+tied input/output embeddings with the 1/sqrt(d_model) logits scale.
+Attention keeps the additive position bias, so it uses the materialized
+softmax path rather than the Pallas kernel (the kernel has no bias operand
+yet); seq lengths for T5 workloads are short enough that this is the right
+trade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: Optional[int] = None
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    decoder_start_token_id: int = 0
+    pad_token_id: int = 0
+
+    @property
+    def n_dec(self) -> int:
+        return self.num_decoder_layers or self.num_layers
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2, num_heads=4,
+            relative_attention_num_buckets=8, relative_attention_max_distance=32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def t5_small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def t5_base(cls, **kw):
+        return cls(d_model=768, d_ff=3072, num_layers=12, num_heads=12, **kw)
+
+
+class T5LayerNorm(nn.Module):
+    """RMS norm without bias/mean subtraction (T5 style)."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+def relative_position_bucket(relative_position, *, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """T5's log-spaced relative position bucketing (exact semantics of the
+    original implementation, restated)."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    causal: bool = False
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None, bias=None):
+        """x: (B, Sq, D); kv: (B, Sk, D) for cross-attention (defaults to x).
+        ``mask``: (B, Sk) key validity. ``bias``: precomputed position bias
+        (B? 1, H, Sq, Sk) — layers past the first reuse the first layer's.
+        Returns (out, bias_used)."""
+        cfg = self.config
+        kv = x if kv is None else kv
+        sq, sk = x.shape[1], kv.shape[1]
+        dense = partial(
+            nn.DenseGeneral, features=(cfg.num_heads, cfg.d_kv), use_bias=False,
+            dtype=cfg.dtype, param_dtype=jnp.float32,
+        )
+        q = dense(name="q")(x)
+        k = dense(name="k")(kv)
+        v = dense(name="v")(kv)
+        # T5 does NOT scale by 1/sqrt(d): the initializer absorbs it.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+
+        if bias is None:
+            if self.has_relative_bias:
+                rel = (
+                    jnp.arange(sk, dtype=jnp.int32)[None, :]
+                    - jnp.arange(sq, dtype=jnp.int32)[:, None]
+                )
+                buckets = relative_position_bucket(
+                    rel, bidirectional=not self.causal,
+                    num_buckets=cfg.relative_attention_num_buckets,
+                    max_distance=cfg.relative_attention_max_distance,
+                )
+                table = nn.Embed(
+                    cfg.relative_attention_num_buckets, cfg.num_heads,
+                    param_dtype=jnp.float32, name="relative_attention_bias",
+                )(buckets)  # (Sq, Sk, H)
+                bias = jnp.transpose(table, (2, 0, 1))[None]  # (1, H, Sq, Sk)
+            else:
+                bias = jnp.zeros((1, cfg.num_heads, sq, sk), jnp.float32)
+            if self.causal:
+                cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                bias = jnp.where(cmask[None, None], bias, jnp.float32(-1e9))
+        scores = scores + bias
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="o",
+        )(out)
+        return out, bias
+
+
+class T5FFN(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="wi")(x)
+        h = nn.relu(h)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="wo")(h)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask, bias):
+        cfg = self.config
+        h, bias = T5Attention(cfg, causal=False, has_relative_bias=self.has_relative_bias,
+                              name="self_attn")(T5LayerNorm(cfg.layer_norm_epsilon,
+                                                            name="ln0")(x), mask=mask, bias=bias)
+        x = x + h
+        x = x + T5FFN(cfg, name="ffn")(T5LayerNorm(cfg.layer_norm_epsilon, name="ln1")(x))
+        return x, bias
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, enc, self_bias, enc_mask):
+        cfg = self.config
+        h, self_bias = T5Attention(
+            cfg, causal=True, has_relative_bias=self.has_relative_bias, name="self_attn"
+        )(T5LayerNorm(cfg.layer_norm_epsilon, name="ln0")(x), bias=self_bias)
+        x = x + h
+        h, _ = T5Attention(cfg, causal=False, name="cross_attn")(
+            T5LayerNorm(cfg.layer_norm_epsilon, name="ln1")(x), kv=enc, mask=enc_mask,
+        )
+        x = x + h
+        x = x + T5FFN(cfg, name="ffn")(T5LayerNorm(cfg.layer_norm_epsilon, name="ln2")(x))
+        return x, self_bias
+
+
+class T5Stack(nn.Module):
+    config: T5Config
+    is_decoder: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, enc=None, enc_mask=None):
+        cfg = self.config
+        n = cfg.n_dec if self.is_decoder else cfg.num_layers
+        bias = None
+        # First layer owns the shared relative bias; scan keeps the remaining
+        # (bias-reusing) layers rolled into one compiled block.
+        if self.is_decoder:
+            x, bias = T5DecoderBlock(cfg, has_relative_bias=True, name="block_0")(
+                x, enc, None, enc_mask
+            )
+        else:
+            x, bias = T5EncoderBlock(cfg, has_relative_bias=True, name="block_0")(
+                x, mask, None
+            )
+        rest = n - 1
+        if rest > 0 and cfg.scan_layers:
+            if self.is_decoder:
+
+                class _Rest(nn.Module):
+                    cfg_: T5Config
+
+                    @nn.compact
+                    def __call__(self, carry, _):
+                        h, _ = T5DecoderBlock(self.cfg_, name="block")(
+                            carry[0], carry[1], carry[2], carry[3]
+                        )
+                        return (h, carry[1], carry[2], carry[3]), None
+
+                block = nn.remat(_Rest, prevent_cse=False) if cfg.remat else _Rest
+                scanned = nn.scan(
+                    block, variable_axes={"params": 0}, split_rngs={"params": True},
+                    length=rest, metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, name="layers")
+                (x, _, _, _), _ = scanned((x, enc, bias, enc_mask), None)
+            else:
+
+                class _Rest(nn.Module):
+                    cfg_: T5Config
+
+                    @nn.compact
+                    def __call__(self, carry, _):
+                        h, _ = T5EncoderBlock(self.cfg_, name="block")(
+                            carry[0], carry[1], carry[2]
+                        )
+                        return (h, carry[1], carry[2]), None
+
+                block = nn.remat(_Rest, prevent_cse=False) if cfg.remat else _Rest
+                scanned = nn.scan(
+                    block, variable_axes={"params": 0}, split_rngs={"params": True},
+                    length=rest, metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, name="layers")
+                (x, _, _), _ = scanned((x, mask, bias), None)
+        else:
+            for i in range(rest):
+                if self.is_decoder:
+                    x, _ = T5DecoderBlock(cfg, name=f"block_{i+1}")(x, enc, bias, enc_mask)
+                else:
+                    x, _ = T5EncoderBlock(cfg, name=f"block_{i+1}")(x, mask, bias)
+        return T5LayerNorm(cfg.layer_norm_epsilon, name="final_ln")(x)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="shared")
+        if attention_mask is None:
+            attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+        enc = T5Stack(cfg, is_decoder=False, name="encoder")(
+            embed(input_ids), mask=attention_mask
+        )
+        dec = T5Stack(cfg, is_decoder=True, name="decoder")(
+            embed(decoder_input_ids), enc=enc, enc_mask=attention_mask
+        )
+        # Tied head with the 1/sqrt(d_model) scale of untied-rescale T5.
+        logits = (dec * (cfg.d_model ** -0.5)) @ embed.embedding.T.astype(cfg.dtype)
+        return logits
+
+
+def shift_tokens_right(labels, decoder_start_token_id: int = 0):
+    """Teacher-forcing inputs: [start, y0, y1, ...]."""
+    return jnp.concatenate(
+        [jnp.full_like(labels[:, :1], decoder_start_token_id), labels[:, :-1]], axis=1
+    )
+
+
+def t5_cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    mask = labels != ignore_index
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def t5_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    """Megatron column/row-parallel table for T5 (regex on "/"-joined param
+    paths → dim-aligned PartitionSpec tuples; see parallel/sharding.py).
+    block_0 params have no leading layer dim; scanned layers do."""
+    lead = (None,) if scan_layers else ()
+    rules: list[tuple[str, tuple]] = [
+        # First (unscanned) blocks.
+        (r"block_0/(self_attn|cross_attn)/(q|k|v)/kernel", (None, "tp", None)),
+        (r"block_0/(self_attn|cross_attn)/o/kernel", ("tp", None, None)),
+        (r"block_0/ffn/wi/kernel", (None, "tp")),
+        (r"block_0/ffn/wo/kernel", ("tp", None)),
+        # Scanned remainder (leading layer axis).
+        (r"layers/block/(self_attn|cross_attn)/(q|k|v)/kernel", lead + (None, "tp", None)),
+        (r"layers/block/(self_attn|cross_attn)/o/kernel", lead + ("tp", None, None)),
+        (r"layers/block/ffn/wi/kernel", lead + (None, "tp")),
+        (r"layers/block/ffn/wo/kernel", lead + ("tp", None)),
+        # Shared embedding table shards the vocab dim.
+        (r"shared/embedding", ("tp", None)),
+    ]
+    return rules

@@ -83,6 +83,15 @@ def _maybe_init_jax_distributed():
         return
     num = int(os.environ.get("ACCELERATE_NUM_PROCESSES", "1"))
     idx = int(os.environ.get("ACCELERATE_PROCESS_INDEX", "0"))
+    if coord == "auto":
+        # TPU pod: jax discovers coordinator/ranks from the TPU VM metadata
+        # (the gcloud pod launch path sets this — commands/pod.py).
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            if "already initialized" not in str(e):
+                raise
+        return
     if num <= 1:
         return
     try:

@@ -1,0 +1,42 @@
+"""Launched check: gather_for_metrics drops the even_batches-duplicated tail.
+
+Reference analog: test_utils/scripts/external_deps/test_metrics.py — an eval
+loop over an uneven dataset must yield exactly len(dataset) samples after
+gathering, with every sample appearing exactly once.
+"""
+import numpy as np
+
+from accelerate_tpu import Accelerator
+
+acc = Accelerator()
+rank, world = acc.process_index, acc.num_processes
+assert world > 1
+
+N, BS = 4 * world * 3 + 3, 4  # ragged: 3 extra samples
+
+
+class Spec:
+    class dataset:
+        def __len__(self):
+            return N
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    dataset = dataset()
+    batch_size = BS
+    sampler = None
+    drop_last = False
+
+
+dl = acc.prepare(Spec())
+seen = []
+for batch in dl:
+    gathered = acc.gather_for_metrics(batch["x"])
+    seen.extend(np.asarray(gathered).ravel().tolist())
+
+assert len(seen) == N, f"gathered {len(seen)} samples, want {N} (tail not trimmed?)"
+assert sorted(int(v) for v in seen) == list(range(N)), "samples duplicated or lost"
+
+if acc.is_main_process:
+    print("TEST_METRICS OK")

@@ -371,6 +371,11 @@ class ClearMLTracker(GeneralTracker):
                         title=title, series=series or title, value=float(v),
                         iteration=step, **kwargs,
                     )
+            else:
+                logger.warning(
+                    f"ClearMLTracker.log dropped non-scalar value {k!r} "
+                    f"({type(v).__name__}) — only int/float metrics are reported."
+                )
 
     @on_main_process
     def finish(self):

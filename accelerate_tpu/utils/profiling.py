@@ -50,33 +50,40 @@ class ProfileSession:
     def enter(self):
         if not self.scheduled:
             self._start(self.trace_dir)
+        elif self.skip_first == 0 and self.wait + self.warmup == 0:
+            # First active window opens before any step() call arrives.
+            self._start(os.path.join(self.trace_dir, "cycle_0"))
 
     def exit(self):
         if self._tracing:
             self._stop()
 
     def step(self):
-        """Advance the schedule by one training step."""
+        """Advance the schedule by one training step.
+
+        ``step()`` is called AFTER each training step (torch.profiler
+        convention), so window boundaries look one step ahead: the trace
+        starts when the NEXT step is the cycle's first active step and stops
+        right after the cycle's LAST active step completes — the active
+        steps' device work is inside the window.
+        """
         if not self.scheduled:
             return
         self.step_num += 1
-        pos = self.step_num - self.skip_first
+        pos = self.step_num - self.skip_first  # completed non-skipped steps
         if pos <= 0:
             return
         cycle_len = self.wait + self.warmup + self.active
         in_cycle = (pos - 1) % cycle_len
-        cycle_idx = (pos - 1) // cycle_len
-        if self.repeat and cycle_idx >= self.repeat:
-            if self._tracing:
-                self._stop()
-            return
-        # Trace covers the active window: [wait+warmup, wait+warmup+active).
-        # Two independent ifs: with active == 1 the start and stop land on the
-        # SAME step (an elif would merge windows and skip half the cycles).
-        if in_cycle == self.wait + self.warmup and not self._tracing:
-            self._start(os.path.join(self.trace_dir, f"cycle_{cycle_idx}"))
-        if in_cycle == cycle_len - 1 and self._tracing:
+        if self._tracing and in_cycle == cycle_len - 1:
             self._stop()
+        # Look ahead: 0-based index of the NEXT step is `pos`.
+        nxt_cycle_idx = pos // cycle_len
+        nxt_in_cycle = pos % cycle_len
+        if self.repeat and nxt_cycle_idx >= self.repeat:
+            return
+        if not self._tracing and nxt_in_cycle == self.wait + self.warmup:
+            self._start(os.path.join(self.trace_dir, f"cycle_{nxt_cycle_idx}"))
 
     # -- internals ---------------------------------------------------------
 

@@ -219,3 +219,18 @@ def test_mid_epoch_resume_matches_uninterrupted(tmp_path):
         for k in a:
             np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
                                           err_msg=f"batch {i} key {k}")
+
+
+@pytest.mark.slow
+def test_checkpointing_multiprocess():
+    """Launched 2-process save/load/resume equivalence (reference:
+    test_utils/scripts/external_deps/test_checkpointing.py)."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_checkpointing"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""})
+    assert "TEST_CHECKPOINTING OK" in out

@@ -187,6 +187,7 @@ def test_pod_launch_dry_run_gcloud(capsys):
         assert f"--worker={rank}" in line
         assert "--zone=us-central2-b" in line
         assert f"--machine_rank={rank}" in line
+        assert "--main_process_ip=auto" in line  # jax TPU-metadata rendezvous
 
 
 def test_estimate_memory_hub_config_meta_init(tmp_path):
@@ -230,3 +231,35 @@ def test_pod_launch_forwards_all_config_flags(capsys):
                  "--fsdp_activation_checkpointing", "--remat_policy=full",
                  "--no_scan_layers", "--debug", "--jit_cache_dir=/tmp/jc"):
         assert frag in out, frag
+
+
+def test_elastic_restart_recovers(tmp_path):
+    """--max_restarts: the gang restarts after a worker failure and the retry
+    succeeds (reference: torch elastic max_restarts passthrough,
+    commands/launch.py:998-1030)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "attempt = int(os.environ.get('ACCELERATE_RESTART_ATTEMPT', '0'))\n"
+        "rank = os.environ.get('ACCELERATE_PROCESS_INDEX', '0')\n"
+        "if attempt == 0 and rank == '1':\n"
+        "    sys.exit(17)  # simulated worker crash on first attempt\n"
+        "print(f'attempt={attempt} rank={rank} ok')\n"
+    )
+    base = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "launch",
+            "--num_processes=2", "--cpu"]
+    env = {**os.environ, "PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""}
+
+    # Without restarts: fails.
+    r = subprocess.run(base + [str(script)], env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 17, (r.returncode, r.stdout, r.stderr)
+
+    # With one restart: recovers.
+    r = subprocess.run(base + ["--max_restarts=1", str(script)], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "restarting gang" in r.stderr
+    assert "attempt=1 rank=0 ok" in r.stdout

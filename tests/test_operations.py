@@ -127,3 +127,18 @@ def test_ops_multiprocess_shape_preservation():
     ]
     out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
     assert "TEST_OPS OK" in out
+
+
+@pytest.mark.slow
+def test_metrics_multiprocess():
+    """Launched 2-process gather_for_metrics remainder-trim check (reference:
+    test_utils/scripts/external_deps/test_metrics.py)."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_metrics"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""})
+    assert "TEST_METRICS OK" in out

@@ -1,0 +1,135 @@
+"""T5 encoder-decoder family (models/t5.py) — the reference reaches T5 only
+via Megatron's T5TrainStep (utils/megatron_lm.py:640-760); here it is native.
+Covers forward shape, scan/unrolled parity, TP-sharded logits parity, and a
+training-loss decrease under the fused step."""
+
+import numpy as np
+import pytest
+
+
+def _data(cfg, b=2, se=12, sd=8, seed=0):
+    rng = np.random.default_rng(seed)
+    enc_ids = rng.integers(1, cfg.vocab_size, size=(b, se), dtype=np.int32)
+    labels = rng.integers(1, cfg.vocab_size, size=(b, sd), dtype=np.int32)
+    return enc_ids, labels
+
+
+def test_t5_forward_shape_and_finite():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration, shift_tokens_right
+
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    module = T5ForConditionalGeneration(cfg)
+    enc_ids, labels = _data(cfg)
+    dec_in = shift_tokens_right(jnp.asarray(labels))
+    params = module.init(jax.random.key(0), enc_ids, dec_in)["params"]
+    logits = module.apply({"params": params}, enc_ids, dec_in)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_t5_scan_matches_unrolled():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration, shift_tokens_right
+
+    cfg_s = T5Config.tiny(dtype=jnp.float32, num_layers=3, scan_layers=True)
+    cfg_u = T5Config.tiny(dtype=jnp.float32, num_layers=3, scan_layers=False)
+    enc_ids, labels = _data(cfg_s)
+    dec_in = shift_tokens_right(jnp.asarray(labels))
+
+    m_s = T5ForConditionalGeneration(cfg_s)
+    p_s = m_s.init(jax.random.key(0), enc_ids, dec_in)["params"]
+    m_u = T5ForConditionalGeneration(cfg_u)
+
+    # Map scanned params [L-1, ...] onto the unrolled block_{i+1} names.
+    def unstack(tree, idx):
+        return jax.tree.map(lambda x: np.asarray(x)[idx], tree)
+
+    pu = {k: v for k, v in p_s.items() if k not in ("encoder", "decoder")}
+    for stack in ("encoder", "decoder"):
+        src = p_s[stack]
+        dst = {k: v for k, v in src.items() if k != "layers"}
+        if "layers" in src:
+            for i in range(cfg_s.num_layers - 1 if stack == "encoder" else cfg_s.n_dec - 1):
+                dst[f"block_{i+1}"] = unstack(src["layers"]["block"], i)
+        pu[stack] = dst
+    out_s = m_s.apply({"params": p_s}, enc_ids, dec_in)
+    out_u = m_u.apply({"params": pu}, enc_ids, dec_in)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u), rtol=2e-5, atol=2e-5)
+
+
+def test_t5_tp_sharded_logits_match_replicated():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu import AcceleratorState, ParallelismConfig
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration, shift_tokens_right, t5_tp_rules
+    from accelerate_tpu.parallel import plan_parameter_sharding
+
+    AcceleratorState._reset_state()
+    state = AcceleratorState(parallelism_config=ParallelismConfig(tp_size=4, dp_shard_size=2))
+    mesh = state.mesh
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    module = T5ForConditionalGeneration(cfg)
+    enc_ids, labels = _data(cfg)
+    dec_in = shift_tokens_right(jnp.asarray(labels))
+    params = module.init(jax.random.key(0), enc_ids, dec_in)["params"]
+    ref = np.asarray(module.apply({"params": params}, enc_ids, dec_in))
+
+    shardings = plan_parameter_sharding(
+        params, mesh, parallelism_config=state.parallelism_config,
+        tp_rules=t5_tp_rules(cfg.scan_layers), min_size_to_shard=0,
+    )
+    sharded = jax.tree.map(lambda p, s: jax.device_put(p, s), params, shardings)
+    # At least the attention projections must actually be tp-sharded.
+    tp_used = [
+        s for s in jax.tree.leaves(shardings)
+        if any("tp" in (e if isinstance(e, tuple) else (e,)) for e in s.spec if e)
+    ]
+    assert len(tp_used) >= 8, "tp rules matched too few params"
+    out = jax.jit(lambda p: module.apply({"params": p}, enc_ids, dec_in))(sharded)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_t5_trains_loss_decreases():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import (
+        T5Config,
+        T5ForConditionalGeneration,
+        shift_tokens_right,
+        t5_cross_entropy_loss,
+        t5_tp_rules,
+    )
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(0)
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    module = T5ForConditionalGeneration(cfg)
+    enc_ids, labels = _data(cfg, b=8)
+    dec_in = shift_tokens_right(jnp.asarray(labels))
+    acc = Accelerator()
+    model = Model.from_flax(module, jax.random.key(0), enc_ids, np.asarray(dec_in),
+                            tp_rules=t5_tp_rules(cfg.scan_layers))
+    model, _ = acc.prepare(model, optax.adam(1e-3))
+
+    def loss_fn(params, b):
+        logits = module.apply({"params": params}, b["enc"], b["dec_in"])
+        return t5_cross_entropy_loss(logits, b["labels"])
+
+    step = acc.prepare_train_step(loss_fn)
+    batch = {"enc": jnp.asarray(enc_ids), "dec_in": dec_in, "labels": jnp.asarray(labels)}
+    state = acc.train_state
+    losses = []
+    for _ in range(15):
+        state, m = step(state, batch)
+        losses.append(float(np.asarray(m["loss"])))
+    assert losses[-1] < losses[0] - 0.5, losses

@@ -236,3 +236,52 @@ def test_profile_schedule_active_one(tmp_path):
             prof.step()
     assert prof.cycles_done == 2
     assert prof.trace_dirs == [str(tmp_path / "cycle_0"), str(tmp_path / "cycle_1")]
+
+
+def test_profile_schedule_window_covers_active_steps(tmp_path):
+    """The trace must open BEFORE the cycle's active steps run and close
+    after the last one (step() is called post-step) — verified with stubbed
+    start/stop ordering."""
+    from unittest import mock as _mock
+
+    import accelerate_tpu.utils.profiling as P
+    from accelerate_tpu.utils import ProfileKwargs
+
+    events = []
+    handler = ProfileKwargs(
+        schedule_option={"wait": 1, "warmup": 1, "active": 2, "repeat": 2},
+        output_trace_dir=str(tmp_path),
+    )
+    with _mock.patch.object(P.jax.profiler, "start_trace",
+                            lambda d: events.append(("start", d))), \
+         _mock.patch.object(P.jax.profiler, "stop_trace",
+                            lambda: events.append(("stop",))):
+        s = P.ProfileSession(handler, str(tmp_path))
+        s.enter()
+        for i in range(1, 11):
+            events.append(("work", i))
+            s.step()
+        s.exit()
+    i0 = events.index(("start", str(tmp_path / "cycle_0")))
+    j0 = events.index(("stop",))
+    assert [e[1] for e in events[i0:j0] if e[0] == "work"] == [3, 4]
+    i1 = events.index(("start", str(tmp_path / "cycle_1")))
+    j1 = events.index(("stop",), i1)
+    assert [e[1] for e in events[i1:j1] if e[0] == "work"] == [7, 8]
+
+
+def test_clearml_warns_on_non_scalar(caplog):
+    import logging
+
+    from accelerate_tpu.tracking import ClearMLTracker
+
+    task = mock.MagicMock()
+    Task = mock.MagicMock()
+    Task.current_task.return_value = None
+    Task.init.return_value = task
+    mod = _mock_module("clearml", Task=Task)
+    with mock.patch.dict(sys.modules, {"clearml": mod}):
+        t = ClearMLTracker("proj")
+        with caplog.at_level(logging.WARNING):
+            t.log({"stage": "eval", "loss": 0.5}, step=1)
+    assert any("stage" in r.message for r in caplog.records)
