@@ -51,3 +51,9 @@ from .big_modeling import (  # noqa: E402
     init_empty_weights,
     load_checkpoint_and_dispatch,
 )
+from .inference import (  # noqa: E402
+    PipelinedModel,
+    pipeline_stage_layers,
+    prepare_pippy,
+    register_pipeline_plan,
+)

@@ -643,12 +643,19 @@ class Accelerator:
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches=None):
         """Under even_batches sharding every rank always has a batch, so this
-        is advisory (reference: accelerator.py:1299-1381)."""
+        is advisory; the ``even_batches`` override applies only inside the
+        context, like the reference's (reference: accelerator.py:1299-1381)."""
+        overridden = []
         if even_batches is not None:
             for dl in self._dataloaders:
                 if hasattr(dl, "batch_sampler") and hasattr(dl.batch_sampler, "even_batches"):
+                    overridden.append((dl.batch_sampler, dl.batch_sampler.even_batches))
                     dl.batch_sampler.even_batches = even_batches
-        yield
+        try:
+            yield
+        finally:
+            for sampler, old in overridden:
+                sampler.even_batches = old
 
     # ------------------------------------------------------------------
     # Imperative training surface (reference: accelerator.py:2818-2999)

@@ -172,7 +172,7 @@ def launch_command(args: argparse.Namespace) -> int:
     # reference delegates this to torch elastic's max_restarts,
     # commands/launch.py:998-1030); each attempt gets a fresh rendezvous port
     # so stale coordinator state can't poison the retry.
-    max_restarts = int(getattr(args, "max_restarts", 0) or 0)
+    max_restarts = max(0, int(getattr(args, "max_restarts", 0) or 0))
     monitor_interval = float(getattr(args, "monitor_interval", 0.2) or 0.2)
     for attempt in range(max_restarts + 1):
         rc = _run_gang(cmd, base_env, cfg, port, monitor_interval, attempt)

@@ -1,0 +1,212 @@
+"""Pipeline-parallel *inference*: the reference's ``prepare_pippy`` surface.
+
+The reference wraps a torch module with ``torch.distributed.pipelining``
+(reference: inference.py:75-187 — ``generate_device_map`` to place split
+points, ``build_pipeline`` to build a ``ScheduleGPipe`` stage per rank, and
+``pippy_forward`` where rank 0 feeds, the last rank collects, and
+``gather_output`` broadcasts the result). That design is an imperative
+per-rank runtime moving activations with P2P sends.
+
+The TPU-native design has no per-rank runtime: the GPipe schedule is a
+*compiled* transformation (parallel/pp.py ``pipeline_apply`` — ``lax.scan``
+ticks + ``ppermute`` hops over the ``pp`` mesh axis), and the result is a
+global ``jax.Array`` that every process can address. Consequences:
+
+- split points are not needed: stages are contiguous slices of the stacked
+  (``nn.scan`` layout) layer dim, handed out by ``shard_map`` — the analog of
+  the reference's balanced auto-split over equal-memory devices.
+- ``pippy_forward``'s rank choreography disappears; every rank calls the same
+  compiled function on the same global batch.
+- ``gather_output=True`` maps to *replicating* the logits over the mesh
+  (reference semantics: every device ends with a copy); ``False`` leaves the
+  layout wherever GSPMD wants it (resident on the last stage until consumed).
+
+Model families register a pipelined forward in ``PIPELINE_PLANS`` (same
+pattern as ``big_modeling.register_stream_plan``); Llama and GPT-2 plans ship
+built-in. Any model whose blocks are stacked can opt in with a custom plan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .model import Model
+from .parallel.pp import llama_pipeline_forward, pipeline_apply
+
+# module class name -> fn(config, params, input_ids, *, mesh, n_microbatches)
+PIPELINE_PLANS: dict = {}
+
+
+def register_pipeline_plan(module_class_name: str, fn: Callable) -> None:
+    """Register a pipelined forward for a module class (by class name)."""
+    PIPELINE_PLANS[module_class_name] = fn
+
+
+def pipeline_stage_layers(n_layers: int, n_stages: int) -> list[range]:
+    """Which layer indices each pipeline stage owns (contiguous, balanced).
+
+    Debug/parity helper standing in for the reference's ``generate_device_map``
+    split-point report (reference: inference.py:31-57): our stages are always
+    the contiguous ``L/pp`` slices of the stacked layer dim.
+    """
+    if n_layers % n_stages != 0:
+        raise ValueError(f"n_layers {n_layers} not divisible by n_stages {n_stages}")
+    per = n_layers // n_stages
+    return [range(i * per, (i + 1) * per) for i in range(n_stages)]
+
+
+def _layer_norm(x, scale, bias, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _gpt2_stage_fn(config) -> Callable:
+    from .models.gpt2 import GPT2Block
+
+    block = GPT2Block(config)
+
+    def one_layer(h, layer_params):
+        return block.apply({"params": layer_params}, h), None
+
+    if config.remat:
+        one_layer = jax.checkpoint(one_layer, prevent_cse=False)
+
+    def stage_fn(local_layers, h):
+        h, _ = jax.lax.scan(one_layer, h, local_layers)
+        return h
+
+    return stage_fn
+
+
+def gpt2_pipeline_forward(
+    config,
+    params: Any,
+    input_ids: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Pipelined ``GPT2LMHeadModel.apply``: embeddings / final LN / tied head
+    run outside the pipeline (not stacked over layers), blocks ride ``pp``."""
+    if not config.scan_layers:
+        raise ValueError("pipeline inference requires scan_layers=True (stacked blocks)")
+    tr = params["transformer"]
+    wte = tr["wte"]["embedding"]
+    x = jnp.take(wte, input_ids, axis=0).astype(config.dtype)
+    x = x + jnp.take(
+        tr["wpe"]["embedding"], jnp.arange(input_ids.shape[-1]), axis=0
+    ).astype(config.dtype)
+    x = pipeline_apply(
+        _gpt2_stage_fn(config), tr["h"]["block"], x,
+        mesh=mesh, n_microbatches=n_microbatches, axis_name="pp",
+    )
+    ln = tr["ln_f"]
+    x = _layer_norm(x, ln["scale"], ln["bias"], config.layer_norm_epsilon)
+    return (x @ wte.T.astype(config.dtype)).astype(jnp.float32)
+
+
+def _llama_plan(config, params, input_ids, *, mesh, n_microbatches):
+    return llama_pipeline_forward(
+        config, params, input_ids, mesh=mesh, n_microbatches=n_microbatches
+    )
+
+
+PIPELINE_PLANS["LlamaForCausalLM"] = _llama_plan
+PIPELINE_PLANS["GPT2LMHeadModel"] = gpt2_pipeline_forward
+
+
+class PipelinedModel(Model):
+    """A :class:`Model` whose ``__call__`` runs the compiled GPipe schedule.
+
+    Mirrors the reference's wrapped module (inference.py:170-187: ``forward``
+    swapped for ``pippy_forward``; the original kept on ``__wrapped__``) —
+    here the original stays available as ``.inner``.
+    """
+
+    def __init__(self, inner: Model, plan: Callable, mesh: Mesh,
+                 num_chunks: int, gather_output: bool):
+        super().__init__(
+            apply_fn=inner.apply_fn, params=inner._params,
+            extra_state=inner.extra_state, module=inner.module,
+            tp_rules=inner.tp_rules,
+        )
+        self.inner = inner
+        self._accelerator = inner._accelerator
+        self._plan = plan
+        self._pp_mesh = mesh
+        self._num_chunks = num_chunks
+        self._gather_output = gather_output
+
+    @property
+    def params(self):
+        return self.inner.params
+
+    @params.setter
+    def params(self, value):
+        self.inner.params = value
+
+    def __call__(self, input_ids, **kwargs):
+        cfg = getattr(self.module, "config", None)
+        batch = input_ids.shape[0]
+        # The reference pads the batch up to the microbatch count
+        # (inference.py:108-113 via pad_input_tensors); same contract here so
+        # any batch size works.
+        padded = -batch % self._num_chunks
+        if padded:
+            pad = jnp.broadcast_to(input_ids[-1:], (padded,) + input_ids.shape[1:])
+            input_ids = jnp.concatenate([input_ids, pad], axis=0)
+        out = self._plan(
+            cfg, self.params, input_ids,
+            mesh=self._pp_mesh, n_microbatches=self._num_chunks, **kwargs,
+        )
+        out = out[:batch]
+        if self._gather_output:
+            out = jax.device_put(out, NamedSharding(self._pp_mesh, P()))
+        return out
+
+
+def prepare_pippy(
+    model: Model,
+    *,
+    num_chunks: Optional[int] = None,
+    gather_output: bool = False,
+    mesh: Optional[Mesh] = None,
+    forward_fn: Optional[Callable] = None,
+) -> PipelinedModel:
+    """Wrap ``model`` for pipeline-parallel inference over the ``pp`` axis.
+
+    Reference surface: inference.py:130-187 ``prepare_pippy(model,
+    split_points, no_split_module_classes, example_args, …)``. Arguments that
+    exist only to drive torch FX tracing (example args/kwargs, split points,
+    no-split classes) have no analog — stages fall out of the stacked-layer
+    sharding. ``num_chunks`` defaults to the ``pp`` degree, like the
+    reference's default of one chunk per process.
+    """
+    if mesh is None:
+        from .state import AcceleratorState, is_initialized
+
+        if is_initialized() and getattr(AcceleratorState(), "mesh", None) is not None:
+            mesh = AcceleratorState().mesh
+    if mesh is None:
+        raise ValueError("prepare_pippy needs a mesh (pass mesh= or build an Accelerator)")
+    n_stages = mesh.shape.get("pp", 1)
+    if num_chunks is None:
+        num_chunks = max(n_stages, 1)
+    plan = forward_fn
+    if plan is None and model.module is not None:
+        plan = PIPELINE_PLANS.get(type(model.module).__name__)
+    if plan is None:
+        known = ", ".join(sorted(PIPELINE_PLANS))
+        raise ValueError(
+            f"No pipeline plan for {type(model.module).__name__!r}; pass forward_fn= "
+            f"or register_pipeline_plan(). Built-in plans: {known}"
+        )
+    return PipelinedModel(model, plan, mesh, num_chunks, gather_output)

@@ -35,3 +35,13 @@ from .t5 import (
     t5_cross_entropy_loss,
     t5_tp_rules,
 )
+from .hub import (
+    bert_params_from_hf,
+    gpt2_params_from_hf,
+    llama_params_from_hf,
+    llama_params_to_hf,
+    load_pretrained,
+    mixtral_params_from_hf,
+    model_from_pretrained,
+    t5_params_from_hf,
+)

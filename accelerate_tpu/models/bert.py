@@ -118,7 +118,7 @@ class BertBlock(nn.Module):
             **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
         )
         h = dense(cfg.intermediate_size, name="intermediate")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # exact erf GELU (BERT convention)
         h = dense(cfg.hidden_size, name="output")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=deterministic)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="output_norm")(x + h)
@@ -207,7 +207,7 @@ class BertForMaskedLM(nn.Module):
         )
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
                      name="transform")(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=False)  # exact erf GELU (BERT convention)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_norm")(x)
         # Decoder tied to word embeddings (standard BERT MLM head).
         embedding = self.variables["params"]["bert"]["word_embeddings"]["embedding"]

@@ -1,0 +1,508 @@
+"""Hugging Face checkpoint interop: load reference-world weights natively.
+
+The reference is a wrapper around user torch modules, so "model support" means
+transformers checkpoints. For a reference user to switch here, the same
+checkpoints must load into the native flax families — this module owns the
+name/layout mapping (reference big-model load path for comparison:
+utils/modeling.py:1805-2065 ``load_checkpoint_in_model``; here the mapping is
+architectural, torch ``(out, in)`` linear layout → flax ``(in, out)`` kernels,
+per-head reshapes for the fused DenseGeneral projections, layer stacking for
+the ``nn.scan`` layout).
+
+Two directions per family:
+
+- ``*_params_from_hf(cfg, state_dict)`` — HF name→tensor dict (numpy or torch)
+  → our param pytree, ready for ``Model(module=..., params=...)``.
+- ``*_params_to_hf(cfg, params)`` — the inverse, for exporting checkpoints a
+  reference/transformers user can load back.
+
+``load_pretrained(src)`` is the high-level entry: src is a transformers model
+instance, a local checkpoint directory (config.json + *.safetensors /
+pytorch_model.bin), or a (config, state_dict) pair; the family is picked from
+``model_type`` and both config and weights are converted.
+
+Logit parity with transformers is asserted in tests/test_hub.py for every
+family (fp32, tiny configs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    """Accept torch tensors / np arrays / anything array-like."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _t(t) -> np.ndarray:
+    return _np(t).T
+
+
+def _set(tree: dict, path: str, value: np.ndarray) -> None:
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _get(tree: dict, path: str) -> np.ndarray:
+    node = tree
+    for p in path.split("/"):
+        node = node[p]
+    return np.asarray(node)
+
+
+def _stack_layers(per_layer: list[dict]) -> dict:
+    """[{path: arr} per layer] → {path: stacked arr} (the nn.scan layout)."""
+    out = {}
+    for key in per_layer[0]:
+        out[key] = np.stack([layer[key] for layer in per_layer], axis=0)
+    return out
+
+
+def _place_layers(tree, stacked: dict, scan_layers: bool, scan_prefix: str,
+                  unscanned_prefix_fmt: str, n_layers: int) -> None:
+    if scan_layers:
+        for path, arr in stacked.items():
+            _set(tree, f"{scan_prefix}/{path}", arr)
+    else:
+        for path, arr in stacked.items():
+            for i in range(n_layers):
+                _set(tree, unscanned_prefix_fmt.format(i=i) + "/" + path, arr[i])
+
+
+def _collect_layers(params, scan_layers: bool, scan_prefix: str,
+                    unscanned_prefix_fmt: str, n_layers: int, paths: list[str]) -> list[dict]:
+    """Inverse of _place_layers: per-layer dicts of {path: arr}."""
+    layers = []
+    for i in range(n_layers):
+        layer = {}
+        for path in paths:
+            if scan_layers:
+                layer[path] = _get(params, f"{scan_prefix}/{path}")[i]
+            else:
+                layer[path] = _get(params, unscanned_prefix_fmt.format(i=i) + "/" + path)
+        layers.append(layer)
+    return layers
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+def llama_config_from_hf(hf: Any) -> "LlamaConfig":
+    from .llama import LlamaConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return LlamaConfig(
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        intermediate_size=g("intermediate_size"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        num_key_value_heads=g("num_key_value_heads") or g("num_attention_heads"),
+        head_dim=g("head_dim"),
+        max_position_embeddings=g("max_position_embeddings", 4096),
+        rms_norm_eps=g("rms_norm_eps", 1e-5),
+        rope_theta=g("rope_theta", 10000.0),
+        tie_word_embeddings=bool(g("tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, nkv, d = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    tree: dict = {"model": {}}
+    _set(tree, "model/embed_tokens/embedding", _np(sd["model.embed_tokens.weight"]))
+    _set(tree, "model/norm/weight", _np(sd["model.norm.weight"]))
+    if not cfg.tie_word_embeddings:
+        _set(tree, "lm_head/kernel", _t(sd["lm_head.weight"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "self_attn/q_proj/kernel": _t(sd[p + "self_attn.q_proj.weight"]).reshape(h, nh, d),
+            "self_attn/k_proj/kernel": _t(sd[p + "self_attn.k_proj.weight"]).reshape(h, nkv, d),
+            "self_attn/v_proj/kernel": _t(sd[p + "self_attn.v_proj.weight"]).reshape(h, nkv, d),
+            "self_attn/o_proj/kernel": _t(sd[p + "self_attn.o_proj.weight"]).reshape(nh, d, h),
+            "mlp/gate_proj/kernel": _t(sd[p + "mlp.gate_proj.weight"]),
+            "mlp/up_proj/kernel": _t(sd[p + "mlp.up_proj.weight"]),
+            "mlp/down_proj/kernel": _t(sd[p + "mlp.down_proj.weight"]),
+            "input_layernorm/weight": _np(sd[p + "input_layernorm.weight"]),
+            "post_attention_layernorm/weight": _np(sd[p + "post_attention_layernorm.weight"]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "model/layers/block", "model/layers_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+def llama_params_to_hf(cfg, params) -> dict:
+    h, nh, nkv, d = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    sd = {
+        "model.embed_tokens.weight": _get(params, "model/embed_tokens/embedding"),
+        "model.norm.weight": _get(params, "model/norm/weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = _get(params, "lm_head/kernel").T
+    paths = [
+        "self_attn/q_proj/kernel", "self_attn/k_proj/kernel", "self_attn/v_proj/kernel",
+        "self_attn/o_proj/kernel", "mlp/gate_proj/kernel", "mlp/up_proj/kernel",
+        "mlp/down_proj/kernel", "input_layernorm/weight", "post_attention_layernorm/weight",
+    ]
+    for i, layer in enumerate(_collect_layers(
+        params, cfg.scan_layers, "model/layers/block", "model/layers_{i}",
+        cfg.num_hidden_layers, paths,
+    )):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.q_proj.weight"] = layer["self_attn/q_proj/kernel"].reshape(h, nh * d).T
+        sd[p + "self_attn.k_proj.weight"] = layer["self_attn/k_proj/kernel"].reshape(h, nkv * d).T
+        sd[p + "self_attn.v_proj.weight"] = layer["self_attn/v_proj/kernel"].reshape(h, nkv * d).T
+        sd[p + "self_attn.o_proj.weight"] = layer["self_attn/o_proj/kernel"].reshape(nh * d, h).T
+        sd[p + "mlp.gate_proj.weight"] = layer["mlp/gate_proj/kernel"].T
+        sd[p + "mlp.up_proj.weight"] = layer["mlp/up_proj/kernel"].T
+        sd[p + "mlp.down_proj.weight"] = layer["mlp/down_proj/kernel"].T
+        sd[p + "input_layernorm.weight"] = layer["input_layernorm/weight"]
+        sd[p + "post_attention_layernorm.weight"] = layer["post_attention_layernorm/weight"]
+    return {k: np.asarray(v) for k, v in sd.items()}
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (Llama attention + sparse MoE MLP)
+# ---------------------------------------------------------------------------
+
+def mixtral_config_from_hf(hf: Any) -> "MixtralConfig":
+    from .moe import MixtralConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return MixtralConfig(
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        intermediate_size=g("intermediate_size"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        num_key_value_heads=g("num_key_value_heads") or g("num_attention_heads"),
+        max_position_embeddings=g("max_position_embeddings", 4096),
+        rms_norm_eps=g("rms_norm_eps", 1e-5),
+        rope_theta=g("rope_theta", 10000.0),
+        num_local_experts=g("num_local_experts", 8),
+        num_experts_per_tok=g("num_experts_per_tok", 2),
+        router_aux_loss_coef=g("router_aux_loss_coef", 0.02),
+    )
+
+
+def mixtral_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, nkv, d = cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    E = cfg.num_local_experts
+    tree: dict = {"model": {}}
+    _set(tree, "model/embed_tokens/embedding", _np(sd["model.embed_tokens.weight"]))
+    _set(tree, "model/norm/weight", _np(sd["model.norm.weight"]))
+    _set(tree, "lm_head/kernel", _t(sd["lm_head.weight"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        m = p + "block_sparse_moe."
+        layers.append({
+            "self_attn/q_proj/kernel": _t(sd[p + "self_attn.q_proj.weight"]).reshape(h, nh, d),
+            "self_attn/k_proj/kernel": _t(sd[p + "self_attn.k_proj.weight"]).reshape(h, nkv, d),
+            "self_attn/v_proj/kernel": _t(sd[p + "self_attn.v_proj.weight"]).reshape(h, nkv, d),
+            "self_attn/o_proj/kernel": _t(sd[p + "self_attn.o_proj.weight"]).reshape(nh, d, h),
+            "input_layernorm/weight": _np(sd[p + "input_layernorm.weight"]),
+            "post_attention_layernorm/weight": _np(sd[p + "post_attention_layernorm.weight"]),
+            "moe/router": _t(sd[m + "gate.weight"]),
+            # HF experts: w1=gate (f,h), w3=up (f,h), w2=down (h,f); ours are
+            # stacked (E, in, out).
+            "moe/w_gate": np.stack([_t(sd[m + f"experts.{e}.w1.weight"]) for e in range(E)]),
+            "moe/w_up": np.stack([_t(sd[m + f"experts.{e}.w3.weight"]) for e in range(E)]),
+            "moe/w_down": np.stack([_t(sd[m + f"experts.{e}.w2.weight"]) for e in range(E)]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "model/layers/block", "model/layers_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+def gpt2_config_from_hf(hf: Any) -> "GPT2Config":
+    from .gpt2 import GPT2Config
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return GPT2Config(
+        vocab_size=g("vocab_size"),
+        n_positions=g("n_positions", 1024),
+        n_embd=g("n_embd", 768),
+        n_layer=g("n_layer", 12),
+        n_head=g("n_head", 12),
+        layer_norm_epsilon=g("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gpt2_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, d = cfg.n_embd, cfg.n_head, cfg.head_dim
+    # transformers GPT2Model state dicts may or may not carry the
+    # "transformer." prefix depending on the head class.
+    pref = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    tree: dict = {"transformer": {}}
+    _set(tree, "transformer/wte/embedding", _np(sd[pref + "wte.weight"]))
+    _set(tree, "transformer/wpe/embedding", _np(sd[pref + "wpe.weight"]))
+    _set(tree, "transformer/ln_f/scale", _np(sd[pref + "ln_f.weight"]))
+    _set(tree, "transformer/ln_f/bias", _np(sd[pref + "ln_f.bias"]))
+    layers = []
+    for i in range(cfg.n_layer):
+        p = f"{pref}h.{i}."
+        # GPT-2 Conv1D stores weights (in, out) — already the flax kernel
+        # layout, no transpose.
+        layers.append({
+            "ln_1/scale": _np(sd[p + "ln_1.weight"]),
+            "ln_1/bias": _np(sd[p + "ln_1.bias"]),
+            "attn/c_attn/kernel": _np(sd[p + "attn.c_attn.weight"]).reshape(h, 3, nh, d),
+            "attn/c_attn/bias": _np(sd[p + "attn.c_attn.bias"]).reshape(3, nh, d),
+            "attn/c_proj/kernel": _np(sd[p + "attn.c_proj.weight"]).reshape(nh, d, h),
+            "attn/c_proj/bias": _np(sd[p + "attn.c_proj.bias"]),
+            "ln_2/scale": _np(sd[p + "ln_2.weight"]),
+            "ln_2/bias": _np(sd[p + "ln_2.bias"]),
+            "c_fc/kernel": _np(sd[p + "mlp.c_fc.weight"]),
+            "c_fc/bias": _np(sd[p + "mlp.c_fc.bias"]),
+            "c_proj/kernel": _np(sd[p + "mlp.c_proj.weight"]),
+            "c_proj/bias": _np(sd[p + "mlp.c_proj.bias"]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "transformer/h/block", "transformer/h_{i}", cfg.n_layer)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+def bert_config_from_hf(hf: Any, num_labels: int = 2) -> "BertConfig":
+    from .bert import BertConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return BertConfig(
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        intermediate_size=g("intermediate_size"),
+        max_position_embeddings=g("max_position_embeddings", 512),
+        type_vocab_size=g("type_vocab_size", 2),
+        layer_norm_eps=g("layer_norm_eps", 1e-12),
+        hidden_dropout_prob=g("hidden_dropout_prob", 0.1),
+        num_labels=g("num_labels", num_labels),
+    )
+
+
+def bert_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, d = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    pref = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    e = pref + "embeddings."
+    tree: dict = {"bert": {}}
+    _set(tree, "bert/word_embeddings/embedding", _np(sd[e + "word_embeddings.weight"]))
+    _set(tree, "bert/position_embeddings/embedding", _np(sd[e + "position_embeddings.weight"]))
+    _set(tree, "bert/token_type_embeddings/embedding", _np(sd[e + "token_type_embeddings.weight"]))
+    _set(tree, "bert/embeddings_norm/scale", _np(sd[e + "LayerNorm.weight"]))
+    _set(tree, "bert/embeddings_norm/bias", _np(sd[e + "LayerNorm.bias"]))
+    if pref + "pooler.dense.weight" in sd:
+        _set(tree, "bert/pooler/kernel", _t(sd[pref + "pooler.dense.weight"]))
+        _set(tree, "bert/pooler/bias", _np(sd[pref + "pooler.dense.bias"]))
+    if "classifier.weight" in sd:
+        _set(tree, "classifier/kernel", _t(sd["classifier.weight"]))
+        _set(tree, "classifier/bias", _np(sd["classifier.bias"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pref}encoder.layer.{i}."
+        layers.append({
+            "attention/query/kernel": _t(sd[p + "attention.self.query.weight"]).reshape(h, nh, d),
+            "attention/query/bias": _np(sd[p + "attention.self.query.bias"]).reshape(nh, d),
+            "attention/key/kernel": _t(sd[p + "attention.self.key.weight"]).reshape(h, nh, d),
+            "attention/key/bias": _np(sd[p + "attention.self.key.bias"]).reshape(nh, d),
+            "attention/value/kernel": _t(sd[p + "attention.self.value.weight"]).reshape(h, nh, d),
+            "attention/value/bias": _np(sd[p + "attention.self.value.bias"]).reshape(nh, d),
+            "attention/output/kernel": _t(sd[p + "attention.output.dense.weight"]).reshape(nh, d, h),
+            "attention/output/bias": _np(sd[p + "attention.output.dense.bias"]),
+            "attention_norm/scale": _np(sd[p + "attention.output.LayerNorm.weight"]),
+            "attention_norm/bias": _np(sd[p + "attention.output.LayerNorm.bias"]),
+            "intermediate/kernel": _t(sd[p + "intermediate.dense.weight"]),
+            "intermediate/bias": _np(sd[p + "intermediate.dense.bias"]),
+            "output/kernel": _t(sd[p + "output.dense.weight"]),
+            "output/bias": _np(sd[p + "output.dense.bias"]),
+            "output_norm/scale": _np(sd[p + "output.LayerNorm.weight"]),
+            "output_norm/bias": _np(sd[p + "output.LayerNorm.bias"]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "bert/layers/block", "bert/layer_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# T5
+# ---------------------------------------------------------------------------
+
+def t5_config_from_hf(hf: Any) -> "T5Config":
+    from .t5 import T5Config
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return T5Config(
+        vocab_size=g("vocab_size"),
+        d_model=g("d_model"),
+        d_kv=g("d_kv", 64),
+        d_ff=g("d_ff"),
+        num_layers=g("num_layers"),
+        num_decoder_layers=g("num_decoder_layers"),
+        num_heads=g("num_heads"),
+        relative_attention_num_buckets=g("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=g("relative_attention_max_distance", 128),
+        layer_norm_epsilon=g("layer_norm_epsilon", 1e-6),
+        decoder_start_token_id=g("decoder_start_token_id", 0),
+        pad_token_id=g("pad_token_id", 0),
+    )
+
+
+def _t5_attn(sd, p, our, dm, nh, dk) -> dict:
+    return {
+        f"{our}/q/kernel": _t(sd[p + "q.weight"]).reshape(dm, nh, dk),
+        f"{our}/k/kernel": _t(sd[p + "k.weight"]).reshape(dm, nh, dk),
+        f"{our}/v/kernel": _t(sd[p + "v.weight"]).reshape(dm, nh, dk),
+        f"{our}/o/kernel": _t(sd[p + "o.weight"]).reshape(nh, dk, dm),
+    }
+
+
+def t5_params_from_hf(cfg, sd: dict) -> dict:
+    dm, nh, dk = cfg.d_model, cfg.num_heads, cfg.d_kv
+    tree: dict = {}
+    _set(tree, "shared/embedding", _np(sd["shared.weight"]))
+    _set(tree, "encoder/final_ln/weight", _np(sd["encoder.final_layer_norm.weight"]))
+    _set(tree, "decoder/final_ln/weight", _np(sd["decoder.final_layer_norm.weight"]))
+
+    def enc_layer(i):
+        p = f"encoder.block.{i}."
+        layer = _t5_attn(sd, p + "layer.0.SelfAttention.", "self_attn", dm, nh, dk)
+        layer["ln0/weight"] = _np(sd[p + "layer.0.layer_norm.weight"])
+        layer["ffn/wi/kernel"] = _t(sd[p + "layer.1.DenseReluDense.wi.weight"])
+        layer["ffn/wo/kernel"] = _t(sd[p + "layer.1.DenseReluDense.wo.weight"])
+        layer["ln1/weight"] = _np(sd[p + "layer.1.layer_norm.weight"])
+        return layer
+
+    def dec_layer(i):
+        p = f"decoder.block.{i}."
+        layer = _t5_attn(sd, p + "layer.0.SelfAttention.", "self_attn", dm, nh, dk)
+        layer["ln0/weight"] = _np(sd[p + "layer.0.layer_norm.weight"])
+        layer.update(_t5_attn(sd, p + "layer.1.EncDecAttention.", "cross_attn", dm, nh, dk))
+        layer["ln1/weight"] = _np(sd[p + "layer.1.layer_norm.weight"])
+        layer["ffn/wi/kernel"] = _t(sd[p + "layer.2.DenseReluDense.wi.weight"])
+        layer["ffn/wo/kernel"] = _t(sd[p + "layer.2.DenseReluDense.wo.weight"])
+        layer["ln2/weight"] = _np(sd[p + "layer.2.layer_norm.weight"])
+        return layer
+
+    for stack, n, make in (("encoder", cfg.num_layers, enc_layer),
+                           ("decoder", cfg.n_dec, dec_layer)):
+        first = make(0)
+        first["self_attn/relative_attention_bias/embedding"] = _np(
+            sd[f"{stack}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+        )
+        for path, arr in first.items():
+            _set(tree, f"{stack}/block_0/{path}", arr)
+        rest = [make(i) for i in range(1, n)]
+        if rest and cfg.scan_layers:
+            for path, arr in _stack_layers(rest).items():
+                _set(tree, f"{stack}/layers/block/{path}", arr)
+        else:
+            # unscanned names are block_1..block_{n-1}
+            for i in range(1, n):
+                for path, arr in rest[i - 1].items():
+                    _set(tree, f"{stack}/block_{i}/{path}", arr)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# High-level entry
+# ---------------------------------------------------------------------------
+
+_FAMILIES = {
+    "llama": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
+    "mistral": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
+    "mixtral": ("MixtralForCausalLM", mixtral_config_from_hf, mixtral_params_from_hf),
+    "gpt2": ("GPT2LMHeadModel", gpt2_config_from_hf, gpt2_params_from_hf),
+    "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),
+    "t5": ("T5ForConditionalGeneration", t5_config_from_hf, t5_params_from_hf),
+}
+
+
+def _read_checkpoint_dir(path: str) -> tuple[dict, dict]:
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    sd: dict = {}
+    shards = sorted(fn for fn in os.listdir(path) if fn.endswith(".safetensors"))
+    if shards:
+        from safetensors.numpy import load_file
+
+        for fn in shards:
+            sd.update(load_file(os.path.join(path, fn)))
+    elif os.path.exists(os.path.join(path, "pytorch_model.bin")):
+        import torch
+
+        raw = torch.load(os.path.join(path, "pytorch_model.bin"),
+                         map_location="cpu", weights_only=True)
+        sd = {k: _np(v) for k, v in raw.items()}
+    else:
+        raise FileNotFoundError(f"No *.safetensors or pytorch_model.bin under {path}")
+    return hf_cfg, sd
+
+
+def load_pretrained(src, family: Optional[str] = None, dtype=jnp.bfloat16):
+    """HF checkpoint → (our_config, params, module_class).
+
+    ``src``: transformers ``PreTrainedModel``, a local checkpoint directory,
+    or a ``(hf_config, state_dict)`` pair.
+    """
+    if isinstance(src, str):
+        hf_cfg, sd = _read_checkpoint_dir(src)
+    elif isinstance(src, tuple):
+        hf_cfg, sd = src
+        sd = {k: _np(v) for k, v in sd.items()}
+    else:  # transformers model instance
+        hf_cfg = src.config
+        sd = {k: _np(v) for k, v in src.state_dict().items()}
+    if family is None:
+        family = (hf_cfg.get("model_type") if isinstance(hf_cfg, dict)
+                  else getattr(hf_cfg, "model_type", None))
+    if family not in _FAMILIES:
+        known = ", ".join(sorted(_FAMILIES))
+        raise ValueError(f"Unsupported model family {family!r}; supported: {known}")
+    cls_name, cfg_fn, params_fn = _FAMILIES[family]
+    import dataclasses as _dc
+
+    cfg = _dc.replace(cfg_fn(hf_cfg), dtype=dtype)
+    params = params_fn(cfg, sd)
+    import importlib
+
+    models_pkg = importlib.import_module(__package__)
+    return cfg, params, getattr(models_pkg, cls_name)
+
+
+def model_from_pretrained(src, family: Optional[str] = None, dtype=jnp.bfloat16):
+    """HF checkpoint → ready-to-run :class:`accelerate_tpu.Model`."""
+    from ..model import Model
+
+    cfg, params, cls = load_pretrained(src, family=family, dtype=dtype)
+    return Model(module=cls(cfg), params=params)

@@ -294,11 +294,14 @@ class T5ForConditionalGeneration(nn.Module):
         return logits
 
 
-def shift_tokens_right(labels, decoder_start_token_id: int = 0):
-    """Teacher-forcing inputs: [start, y0, y1, ...]."""
-    return jnp.concatenate(
+def shift_tokens_right(labels, decoder_start_token_id: int = 0, pad_token_id: int = 0):
+    """Teacher-forcing inputs: [start, y0, y1, ...]. Label padding (-100, the
+    ignore_index of t5_cross_entropy_loss) is replaced with pad_token_id —
+    negative ids would otherwise wrap around the embedding table."""
+    shifted = jnp.concatenate(
         [jnp.full_like(labels[:, :1], decoder_start_token_id), labels[:, :-1]], axis=1
     )
+    return jnp.where(shifted < 0, pad_token_id, shifted)
 
 
 def t5_cross_entropy_loss(logits, labels, ignore_index: int = -100):
@@ -312,20 +315,28 @@ def t5_cross_entropy_loss(logits, labels, ignore_index: int = -100):
 def t5_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
     """Megatron column/row-parallel table for T5 (regex on "/"-joined param
     paths → dim-aligned PartitionSpec tuples; see parallel/sharding.py).
-    block_0 params have no leading layer dim; scanned layers do."""
-    lead = (None,) if scan_layers else ()
-    rules: list[tuple[str, tuple]] = [
+    With scan_layers, block_0 params have no leading layer dim while the
+    scanned remainder does; unscanned (block_{i}) layers never do, so their
+    rules match any block name."""
+    if not scan_layers:
+        return [
+            (r"(self_attn|cross_attn)/(q|k|v)/kernel", (None, "tp", None)),
+            (r"(self_attn|cross_attn)/o/kernel", ("tp", None, None)),
+            (r"ffn/wi/kernel", (None, "tp")),
+            (r"ffn/wo/kernel", ("tp", None)),
+            (r"shared/embedding", ("tp", None)),
+        ]
+    return [
         # First (unscanned) blocks.
         (r"block_0/(self_attn|cross_attn)/(q|k|v)/kernel", (None, "tp", None)),
         (r"block_0/(self_attn|cross_attn)/o/kernel", ("tp", None, None)),
         (r"block_0/ffn/wi/kernel", (None, "tp")),
         (r"block_0/ffn/wo/kernel", ("tp", None)),
         # Scanned remainder (leading layer axis).
-        (r"layers/block/(self_attn|cross_attn)/(q|k|v)/kernel", lead + (None, "tp", None)),
-        (r"layers/block/(self_attn|cross_attn)/o/kernel", lead + ("tp", None, None)),
-        (r"layers/block/ffn/wi/kernel", lead + (None, "tp")),
-        (r"layers/block/ffn/wo/kernel", lead + ("tp", None)),
+        (r"layers/block/(self_attn|cross_attn)/(q|k|v)/kernel", (None, None, "tp", None)),
+        (r"layers/block/(self_attn|cross_attn)/o/kernel", (None, "tp", None, None)),
+        (r"layers/block/ffn/wi/kernel", (None, None, "tp")),
+        (r"layers/block/ffn/wo/kernel", (None, "tp", None)),
         # Shared embedding table shards the vocab dim.
         (r"shared/embedding", ("tp", None)),
     ]
-    return rules

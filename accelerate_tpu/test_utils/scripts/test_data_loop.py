@@ -1,0 +1,70 @@
+"""Launched check: uneven-tail dataloader semantics across real processes.
+
+Reference: test_utils/scripts/test_distributed_data_loop.py — even_batches
+cycling vs truncation, and `join_uneven_inputs` temporarily overriding
+even_batches on prepared loaders.
+"""
+import numpy as np
+
+from accelerate_tpu import Accelerator, prepare_data_loader
+from accelerate_tpu.utils import gather_object
+
+acc = Accelerator()
+rank, world = acc.process_index, acc.num_processes
+assert world == 2, "script expects exactly 2 processes"
+
+
+class DS:
+    """11 samples, batch 4 → 3 sampler batches (4, 4, 3): an uneven tail."""
+
+    def __len__(self):
+        return 11
+
+    def __getitem__(self, i):
+        return np.float32(i)
+
+
+class _Loader:
+    """Minimal DataLoader-shaped object for prepare_data_loader."""
+
+    def __init__(self, batch_size=4):
+        self.dataset = DS()
+        self.batch_size = batch_size
+        self.shuffle = False
+        self.drop_last = False
+        self.collate_fn = lambda s: np.asarray(s, dtype=np.float32)
+        self.num_workers = 0
+
+
+def batches(even_batches):
+    dl = prepare_data_loader(
+        _Loader(), even_batches=even_batches, put_on_device=False
+    )
+    return [np.asarray(b).tolist() for b in dl]
+
+
+# --- even_batches=True (default): both ranks see the same batch count, the
+# short tail is completed by cycling from the start -------------------------
+got = batches(even_batches=True)
+counts = gather_object([len(got)])
+assert counts[0] == counts[1], f"even_batches must equalize counts, got {counts}"
+flat = [int(x) for b in gather_object([got]) for batch in b for x in batch]
+assert set(range(11)).issubset(set(flat)), f"all samples must appear, got {sorted(set(flat))}"
+
+# --- even_batches=False: no cycling; one rank gets the short tail ----------
+got = batches(even_batches=False)
+sizes = gather_object([[len(b) for b in got]])
+all_sizes = sorted(s for rank_sizes in sizes for s in rank_sizes)
+assert all_sizes.count(3) == 1, f"exactly one short (3-sample) tail batch: {sizes}"
+assert sum(all_sizes) == 11, f"no duplication when even_batches=False: {all_sizes}"
+
+# --- join_uneven_inputs flips even_batches only inside the context ----------
+dl = acc.prepare_data_loader(_Loader(), device_placement=False)
+before = dl.batch_sampler.even_batches
+with acc.join_uneven_inputs([None], even_batches=False):
+    inside = dl.batch_sampler.even_batches
+after = dl.batch_sampler.even_batches
+assert (before, inside, after) == (True, False, True), (before, inside, after)
+
+if acc.is_main_process:
+    print("TEST_DATA_LOOP OK")

@@ -71,7 +71,10 @@ class ProfileSession:
             return
         self.step_num += 1
         pos = self.step_num - self.skip_first  # completed non-skipped steps
-        if pos <= 0:
+        # pos == 0 must fall through: with wait+warmup == 0 the look-ahead
+        # start for cycle_0 fires exactly there (enter() only covers
+        # skip_first == 0).
+        if pos < 0:
             return
         cycle_len = self.wait + self.warmup + self.active
         in_cycle = (pos - 1) % cycle_len

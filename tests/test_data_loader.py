@@ -171,3 +171,19 @@ def test_dispatcher_batch_semantics_multiprocess():
     ]
     out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
     assert "TEST_DISPATCH OK" in out
+
+
+@pytest.mark.slow
+def test_uneven_data_loop_multiprocess():
+    """Launched 2-process run of test_data_loop (reference:
+    test_utils/scripts/test_distributed_data_loop.py): even_batches cycling vs
+    truncation and the join_uneven_inputs override."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2) + [
+        "--cpu", "-m", "accelerate_tpu.test_utils.scripts.test_data_loop"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
+    assert "TEST_DATA_LOOP OK" in out

@@ -1,0 +1,160 @@
+"""HF-checkpoint interop: converted weights reproduce transformers logits.
+
+The reference wraps transformers models directly, so the switch-over story
+for its users is "your checkpoints load here". Each test builds a tiny
+randomly-initialized transformers model on CPU, converts its state dict with
+models/hub.py, and asserts fp32 logit parity between the torch forward and
+the native flax forward.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from accelerate_tpu import Model
+from accelerate_tpu.models import load_pretrained, model_from_pretrained
+from accelerate_tpu.models.hub import llama_params_from_hf, llama_params_to_hf
+
+
+def _logits(hf_model, *args):
+    hf_model.eval()
+    with torch.no_grad():
+        return hf_model(*[torch.from_numpy(np.asarray(a)) for a in args]).logits.numpy()
+
+
+def _ids(rng, vocab, shape):
+    return rng.integers(0, vocab, shape).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+def _convert(hf_model, **kw):
+    return model_from_pretrained(hf_model, dtype=jnp.float32, **kw)
+
+
+def test_llama_logit_parity(rng):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    ids = _ids(rng, 128, (2, 12))
+    ours = _convert(hf)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_llama_roundtrip_to_hf(rng):
+    """to_hf(from_hf(sd)) == sd exactly — export keeps reference-world layout."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    cfg, params, _ = load_pretrained(hf, dtype=jnp.float32)
+    back = llama_params_to_hf(cfg, llama_params_from_hf(cfg, sd))
+    for k, v in back.items():
+        np.testing.assert_array_equal(v, sd[k], err_msg=k)
+
+
+def test_gpt2_logit_parity(rng):
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=3, n_head=4,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    ids = _ids(rng, 128, (2, 12))
+    ours = _convert(hf)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_bert_logit_parity(rng):
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        intermediate_size=128, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, num_labels=3,
+    )
+    torch.manual_seed(0)
+    hf = transformers.BertForSequenceClassification(hf_cfg)
+    ids = _ids(rng, 128, (2, 12))
+    mask = np.ones_like(ids)
+    ours = _convert(hf)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids, mask)), _logits(hf, ids, mask), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_t5_logit_parity(rng):
+    hf_cfg = transformers.T5Config(
+        vocab_size=128, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=32, feed_forward_proj="relu",
+        tie_word_embeddings=True, decoder_start_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg)
+    ids = _ids(rng, 128, (2, 10))
+    dec = _ids(rng, 128, (2, 7))
+    ours = _convert(hf)
+    hf.eval()
+    with torch.no_grad():
+        want = hf(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            decoder_input_ids=torch.from_numpy(dec.astype(np.int64)),
+        ).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours(ids, dec)), want, rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_logit_parity(rng):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    ids = _ids(rng, 128, (1, 8))
+    cfg, params, cls = load_pretrained(hf, dtype=jnp.float32)
+    # Capacity must cover every routed token or GShard dispatch drops some and
+    # parity with HF's dropless top-k breaks.
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, capacity_factor=float(cfg.num_local_experts))
+    ours = Model(module=cls(cfg), params=params)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=5e-4, atol=5e-4
+    )
+
+
+def test_load_pretrained_from_directory(tmp_path, rng):
+    """config.json + model.safetensors on disk — the checkpoint-dir path."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    ours = model_from_pretrained(str(tmp_path), dtype=jnp.float32)
+    ids = _ids(rng, 64, (2, 8))
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_unknown_family_raises():
+    with pytest.raises(ValueError, match="Unsupported model family"):
+        load_pretrained(({"model_type": "umbrellanet"}, {}))

@@ -1,0 +1,93 @@
+"""Pipeline-parallel inference (`prepare_pippy`) on the virtual CPU mesh.
+
+Mirrors the reference's pippy coverage (reference:
+test_utils/scripts/external_deps/test_pippy.py — forward parity + batch
+handling) with exact checks against the unpipelined forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Model, ParallelismConfig, prepare_pippy
+from accelerate_tpu.inference import pipeline_stage_layers
+from accelerate_tpu.models import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def _mesh(pp):
+    return ParallelismConfig(pp_size=pp).build_mesh()
+
+
+def _llama_model(layers=4):
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, num_hidden_layers=layers)
+    module = LlamaForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+    return Model.from_flax(module, jax.random.key(0), ids), jnp.asarray(ids)
+
+
+def test_prepare_pippy_llama_matches_unpipelined():
+    model, ids = _llama_model()
+    piped = prepare_pippy(model, mesh=_mesh(4))
+    np.testing.assert_allclose(
+        np.asarray(piped(ids)), np.asarray(model(ids)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_prepare_pippy_pads_odd_batches():
+    model, ids = _llama_model()
+    piped = prepare_pippy(model, mesh=_mesh(4), num_chunks=4)
+    odd = ids[:6]  # 6 % 4 != 0 — reference pads via pad_input_tensors
+    out = piped(odd)
+    assert out.shape[0] == 6
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(model(odd)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_prepare_pippy_gather_output_replicates():
+    model, ids = _llama_model()
+    mesh = _mesh(4)
+    out = prepare_pippy(model, mesh=mesh, gather_output=True)(ids)
+    assert out.sharding.is_fully_replicated
+
+
+def test_prepare_pippy_gpt2_matches_unpipelined():
+    set_seed(0)
+    cfg = GPT2Config.tiny(dtype=jnp.float32, n_layer=4)
+    module = GPT2LMHeadModel(cfg)
+    ids = np.random.default_rng(1).integers(0, cfg.vocab_size, (8, 16), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    piped = prepare_pippy(model, mesh=_mesh(2), num_chunks=4)
+    np.testing.assert_allclose(
+        np.asarray(piped(jnp.asarray(ids))), np.asarray(model(jnp.asarray(ids))),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_prepare_pippy_unknown_model_raises():
+    import flax.linen as nn
+
+    class Odd(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    model = Model.from_flax(Odd(), jax.random.key(0), jnp.ones((2, 4)))
+    with pytest.raises(ValueError, match="No pipeline plan"):
+        prepare_pippy(model, mesh=_mesh(2))
+
+
+def test_pipeline_stage_layers():
+    assert [list(r) for r in pipeline_stage_layers(8, 4)] == [
+        [0, 1], [2, 3], [4, 5], [6, 7]
+    ]
+    with pytest.raises(ValueError):
+        pipeline_stage_layers(6, 4)

@@ -133,3 +133,28 @@ def test_t5_trains_loss_decreases():
         state, m = step(state, batch)
         losses.append(float(np.asarray(m["loss"])))
     assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_shift_tokens_right_replaces_ignore_index():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models import shift_tokens_right
+
+    labels = jnp.asarray([[5, 6, -100, -100]])
+    out = np.asarray(shift_tokens_right(labels, decoder_start_token_id=0, pad_token_id=0))
+    assert out.tolist() == [[0, 5, 6, 0]]  # -100 never reaches the embedding
+
+
+def test_t5_tp_rules_cover_unscanned_layers():
+    """Unscanned layers are named block_{i}; the scan_layers=False table must
+    match them (round-2 review finding: they silently stayed replicated)."""
+    import re
+
+    from accelerate_tpu.models import t5_tp_rules
+
+    rules = t5_tp_rules(scan_layers=False)
+    path = "encoder/block_3/self_attn/q/kernel"
+    assert any(re.search(pat, path) for pat, _ in rules), "block_3 params must shard"
+    ffn = "decoder/block_2/ffn/wi/kernel"
+    assert any(re.search(pat, ffn) for pat, _ in rules)
