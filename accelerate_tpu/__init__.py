@@ -45,7 +45,9 @@ from .launchers import debug_launcher, notebook_launcher  # noqa: E402
 from .local_sgd import LocalSGD  # noqa: E402
 from .big_modeling import (  # noqa: E402
     DispatchedModel,
+    UserCpuOffloadHook,
     cpu_offload,
+    cpu_offload_with_hook,
     disk_offload,
     dispatch_model,
     init_empty_weights,

@@ -793,6 +793,7 @@ class Accelerator:
         loss_fn: Callable,
         *,
         has_aux: bool = False,
+        mutable_state: bool = False,
         max_grad_norm: Optional[float] = None,
         donate: Optional[bool] = None,
     ) -> Callable:
@@ -807,9 +808,19 @@ class Accelerator:
           updated; fp16 loss scaling handled.
         - ``donate``: state buffers are donated so params/opt-state update in
           place in HBM (default from JitConfig).
+        - ``mutable_state``: for models carrying non-param collections that
+          the forward updates (flax ``batch_stats`` — BatchNorm). The loss fn
+          then takes ``(params, extra_state, batch)`` and returns
+          ``(loss, new_extra_state)``; the step threads the updated
+          collections through ``state.extra_state``. Because the batch axis
+          is dp-sharded under GSPMD, BatchNorm's batch reductions compile to
+          cross-device means — sync-BN semantics with no extra machinery
+          (the reference needs SyncBatchNorm conversion for this).
         """
         if self._train_state is None:
             raise RuntimeError("Call accelerator.prepare(...) first.")
+        if mutable_state and has_aux:
+            raise ValueError("mutable_state and has_aux are mutually exclusive")
         if donate is None:
             donate = self.jit_config.donate_state
         policy = self._mp_policy
@@ -819,20 +830,25 @@ class Accelerator:
         max_norm = float(max_grad_norm or 0.0)
         grad_shardings = self._grad_shardings  # ZeRO-2: reduce-scatter grads
 
-        def _loss_and_grads(params, loss_scale, microbatch):
+        def _loss_and_grads(params, extra, loss_scale, microbatch):
             def _fn(p):
-                out = loss_fn(policy.cast_for_compute(p), microbatch)
-                loss, aux = (out if has_aux else (out, None))
+                if mutable_state:
+                    loss, new_extra = loss_fn(policy.cast_for_compute(p), extra, microbatch)
+                    aux = None
+                else:
+                    out = loss_fn(policy.cast_for_compute(p), microbatch)
+                    loss, aux = (out if has_aux else (out, None))
+                    new_extra = extra
                 scale = loss_scale.scale if loss_scale is not None else 1.0
-                return (loss * scale).astype(jnp.float32), (loss, aux)
+                return (loss * scale).astype(jnp.float32), (loss, aux, new_extra)
 
-            (_, (loss, aux)), grads = jax.value_and_grad(_fn, has_aux=True)(params)
+            (_, (loss, aux, new_extra)), grads = jax.value_and_grad(_fn, has_aux=True)(params)
             if grad_shardings is not None:
                 # SHARD_GRAD_OP: constrain grads to the opt-state sharding so
                 # GSPMD lowers the DP grad sync as reduce-scatter (each chip
                 # keeps only its 1/W slice) instead of all-reduce.
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
-            return loss, aux, grads
+            return loss, aux, new_extra, grads
 
         opt_offload = self._opt_offload  # (device shardings, host shardings) | None
 
@@ -889,24 +905,35 @@ class Accelerator:
                 batch = jax.tree.map(_split_micro, batch)
 
                 def body(carry, microbatch):
-                    grads_acc, loss_acc = carry
-                    loss, _aux, grads = _loss_and_grads(state.params, state.loss_scale, microbatch)
+                    grads_acc, loss_acc, extra = carry
+                    loss, _aux, new_extra, grads = _loss_and_grads(
+                        state.params, extra, state.loss_scale, microbatch
+                    )
                     return (
                         jax.tree.map(jnp.add, grads_acc, grads),
                         loss_acc + loss,
+                        new_extra,
                     ), None
 
                 zeros = jax.tree.map(lambda p: jnp.zeros_like(p), state.params)
-                (grads, loss_sum), _ = jax.lax.scan(body, (zeros, jnp.asarray(0.0, jnp.float32)), batch)
+                (grads, loss_sum, new_extra), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32), state.extra_state), batch
+                )
                 grads = jax.tree.map(lambda g: g / num_accum, grads)
                 new_state, gnorm = _update(state, grads)
+                if mutable_state:
+                    new_state = new_state.replace(extra_state=new_extra)
                 return new_state, {"loss": loss_sum / num_accum, "grad_norm": gnorm}
 
         else:
 
             def step(state: TrainState, batch):
-                loss, _aux, grads = _loss_and_grads(state.params, state.loss_scale, batch)
+                loss, _aux, new_extra, grads = _loss_and_grads(
+                    state.params, state.extra_state, state.loss_scale, batch
+                )
                 new_state, gnorm = _update(state, grads)
+                if mutable_state:
+                    new_state = new_state.replace(extra_state=new_extra)
                 return new_state, {"loss": loss, "grad_norm": gnorm}
 
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())

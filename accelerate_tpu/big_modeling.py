@@ -46,10 +46,12 @@ from .utils.other import flatten_state_dict, unflatten_state_dict
 __all__ = [
     "init_empty_weights",
     "cpu_offload",
+    "cpu_offload_with_hook",
     "disk_offload",
     "dispatch_model",
     "load_checkpoint_and_dispatch",
     "DispatchedModel",
+    "UserCpuOffloadHook",
     "register_stream_plan",
 ]
 
@@ -301,6 +303,71 @@ def disk_offload(model: Model, offload_dir: str, execution_device=None) -> Dispa
     """All params to a disk memmap store (reference: big_modeling.py:233-276)."""
     top = {k: "disk" for k in model.params}
     return dispatch_model(model, top, offload_dir=offload_dir, execution_device=execution_device)
+
+
+class UserCpuOffloadHook:
+    """Handle returned by :func:`cpu_offload_with_hook` — ``offload()`` pushes
+    the model's params back to host RAM (reference: hooks.py UserCpuOffloadHook
+    via big_modeling.py:278-314)."""
+
+    def __init__(self, model: "HookedOffloadModel"):
+        self.model = model
+
+    def offload(self):
+        self.model._to_host()
+
+    def remove(self):
+        self.model._hooked = False
+
+
+class HookedOffloadModel(Model):
+    """Params live on host; the first forward moves them to the chip and they
+    STAY resident until ``hook.offload()`` — the pipeline-friendly variant of
+    :func:`cpu_offload` (each forward of that one re-faults every group)."""
+
+    def __init__(self, inner: Model, execution_device, prev_hook):
+        super().__init__(
+            apply_fn=inner.apply_fn, params=inner._params,
+            extra_state=inner.extra_state, module=inner.module,
+            tp_rules=inner.tp_rules,
+        )
+        self._exec_device = execution_device
+        self._prev_hook = prev_hook
+        self._on_device = False
+        self._hooked = True
+        self._to_host()
+
+    def _host_device(self):
+        return jax.local_devices(backend="cpu")[0]
+
+    def _to_host(self):
+        self._params = jax.device_put(self._params, self._host_device())
+        self._on_device = False
+
+    def __call__(self, *args, **kwargs):
+        if self._hooked:
+            if self._prev_hook is not None:
+                # Chaining: evict the previous pipeline stage before loading
+                # this one (the reference's prev_module_hook contract).
+                self._prev_hook.offload()
+            if not self._on_device:
+                self._params = jax.device_put(self._params, self._exec_device)
+                self._on_device = True
+        return super().__call__(*args, **kwargs)
+
+
+def cpu_offload_with_hook(
+    model: Model, execution_device=None, prev_module_hook: Optional[UserCpuOffloadHook] = None
+) -> tuple[Model, UserCpuOffloadHook]:
+    """Offload to host, but keep params chip-resident between forwards until
+    the returned hook's ``offload()`` runs (reference: big_modeling.py:278-314
+    — the diffusers-style pipeline pattern where model_i's load evicts
+    model_{i-1} via ``prev_module_hook``)."""
+    if execution_device is None:
+        execution_device = jax.devices()[0]
+    hooked = HookedOffloadModel(model, execution_device, prev_module_hook)
+    hook = UserCpuOffloadHook(hooked)
+    return hooked, hook
 
 
 def load_checkpoint_and_dispatch(

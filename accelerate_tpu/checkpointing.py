@@ -103,9 +103,17 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
         lambda x: to_global_host(x) if hasattr(x, 'shape') else x, state.opt_state
     )
     step_host = int(np.asarray(state.step))
+    # Non-param collections (flax batch_stats etc.) ride along so BatchNorm
+    # models resume with their running statistics.
+    extra_host = (
+        jax.tree.map(to_global_host, state.extra_state)
+        if state.extra_state else None
+    )
     if accelerator.is_main_process:
         with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
-            pickle.dump({"opt_state": opt_host, "step": step_host}, f)
+            pickle.dump(
+                {"opt_state": opt_host, "step": step_host, "extra_state": extra_host}, f
+            )
         if state.loss_scale is not None:
             with open(os.path.join(output_dir, f"{SCALER_NAME}.bin"), "wb") as f:
                 pickle.dump(
@@ -198,11 +206,22 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
 
     import jax.numpy as jnp
 
+    extra_state = state.extra_state
+    loaded_extra = opt_payload.get("extra_state")
+    if loaded_extra is not None and extra_state is not None:
+        extra_sh = getattr(shardings, "extra_state", None)
+        extra_state = (
+            jax.tree.map(lambda a, s: jax.device_put(np.asarray(a), s), loaded_extra, extra_sh)
+            if extra_sh is not None
+            else jax.tree.map(lambda a: jnp.asarray(a), loaded_extra)
+        )
+
     accelerator._train_state = state.replace(
         step=jnp.asarray(opt_payload["step"], jnp.int32),
         params=new_params,
         opt_state=new_opt,
         loss_scale=loss_scale,
+        extra_state=extra_state,
     )
 
     for i, scheduler in enumerate(accelerator._schedulers):

@@ -3,6 +3,7 @@ from .llama import (
     LlamaForCausalLM,
     LlamaModel,
     cross_entropy_loss,
+    fused_cross_entropy_loss,
     llama_tp_rules,
 )
 from .gpt2 import (
@@ -44,4 +45,10 @@ from .hub import (
     mixtral_params_from_hf,
     model_from_pretrained,
     t5_params_from_hf,
+)
+from .resnet import (
+    BottleneckBlock,
+    ResNet,
+    ResNetConfig,
+    resnet_loss,
 )

@@ -1,0 +1,129 @@
+"""ResNet-family image classifier, TPU-first.
+
+The reference's CV story is torchvision's ResNet-50 driven by the example
+scripts (reference: examples/cv_example.py — BASELINE.json names it as a
+headline config); the framework itself never owns a CNN. A TPU-native build
+does: this is a native flax ResNet v1.5 with the design points that matter on
+TPU:
+
+- **NHWC (channels-last)** throughout — the conv layout XLA:TPU tiles best.
+- **bf16 compute, fp32 params/stats** via the same MixedPrecisionPolicy flow
+  as the transformer families.
+- **BatchNorm is sync-BN for free**: under GSPMD the batch axis is dp-sharded,
+  so the batch-mean/variance reductions compile to cross-device collectives —
+  what the reference needs `SyncBatchNorm.convert_sync_batchnorm` for.
+  Running stats ride `Model.extra_state` / `TrainState.extra_state` and are
+  updated by `prepare_train_step(..., mutable_state=True)`.
+- Bottleneck blocks with the v1.5 stride placement (stride on the 3×3), zero-
+  init of the last BN scale per block (the standard trick, helps early LR).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    width: int = 64
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(num_classes=4, width=16, stage_sizes=(1, 1))
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def resnet101(cls, **kw):
+        return cls(stage_sizes=(3, 4, 23, 3), **kw)
+
+    @classmethod
+    def resnet152(cls, **kw):
+        return cls(stage_sizes=(3, 8, 36, 3), **kw)
+
+
+class BottleneckBlock(nn.Module):
+    config: ResNetConfig
+    filters: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_eps, dtype=cfg.dtype, param_dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride), name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(4 * self.filters, (1, 1), name="conv3")(y)
+        # Zero-init the block's last BN scale: the block starts as identity.
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1),
+                            strides=(self.stride, self.stride), name="downsample")(x)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Images (B, H, W, 3) → logits (B, num_classes) in fp32."""
+
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=jnp.float32, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=cfg.bn_momentum,
+                         epsilon=cfg.bn_eps, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            filters = cfg.width * (2 ** stage)
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(cfg, filters=filters, stride=stride,
+                                    name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+                        name="classifier")(x)
+
+
+def resnet_loss(module, params, batch_stats, images, labels, train: bool = True):
+    """Cross-entropy loss threading BatchNorm stats — the shape
+    ``prepare_train_step(mutable_state=True)`` expects.
+
+    Returns ``(loss, new_extra_state)`` where extra_state is the flax
+    variables dict ``{"batch_stats": ...}``.
+    """
+    import jax
+
+    logits, mutated = module.apply(
+        {"params": params, **(batch_stats or {})}, images, train=train,
+        mutable=["batch_stats"],
+    )
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1))
+    return loss, dict(mutated)

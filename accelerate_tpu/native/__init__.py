@@ -92,8 +92,13 @@ def get_lib():
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
             ]
+            lib.at_pread_segments.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.at_pread_segments.restype = ctypes.c_int
             lib.at_version.restype = ctypes.c_int
-            assert lib.at_version() == 1
+            assert lib.at_version() == 2
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -163,6 +168,73 @@ def gather_columns(columns: dict[str, np.ndarray], indices, force: bool = False)
     lib.at_gather_columns(
         srcs, row_bytes.ctypes.data, n, idx.ctypes.data, len(idx), dsts, _NUM_THREADS
     )
+    return dict(zip(names, outs))
+
+
+_ST_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def load_safetensors_fast(path: str, force: bool = False):
+    """Whole-file safetensors load with parallel positioned reads.
+
+    Parses the header in Python (8-byte LE length + JSON) and hands every
+    tensor's byte range to ``at_pread_segments`` — hundreds of page-cache
+    memcpys spread over the pool instead of the safetensors lib's serial
+    per-tensor copies. Returns None when the native path can't serve the file
+    (no lib, unknown dtype) so callers fall back to the safetensors lib.
+    """
+    import json
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            hlen = int.from_bytes(f.read(8), "little")
+            header = json.loads(f.read(hlen))
+    except (OSError, ValueError):
+        return None
+    base = 8 + hlen
+    names, offs, sizes, outs = [], [], [], []
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        st_dtype = meta["dtype"]
+        if st_dtype == "BF16":
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        elif st_dtype in _ST_DTYPES:
+            dtype = np.dtype(_ST_DTYPES[st_dtype])
+        else:
+            return None
+        b0, b1 = meta["data_offsets"]
+        arr = np.empty(meta["shape"], dtype=dtype)
+        if arr.nbytes != b1 - b0:
+            return None
+        names.append(name)
+        offs.append(base + b0)
+        sizes.append(b1 - b0)
+        outs.append(arr)
+    if not names:
+        return {}
+    total = sum(sizes)
+    if not force and not (_MULTICORE and total >= NATIVE_MIN_BYTES):
+        return None  # small files: the safetensors lib's mmap is fine
+    n = len(names)
+    dsts = (ctypes.c_void_p * n)(*[a.ctypes.data for a in outs])
+    offs_a = np.ascontiguousarray(offs, dtype=np.int64)
+    sizes_a = np.ascontiguousarray(sizes, dtype=np.int64)
+    rc = lib.at_pread_segments(
+        os.fsencode(path), offs_a.ctypes.data, sizes_a.ctypes.data, dsts, n,
+        _NUM_THREADS,
+    )
+    if rc != 0:
+        return None
     return dict(zip(names, outs))
 
 

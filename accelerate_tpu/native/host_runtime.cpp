@@ -160,6 +160,44 @@ void at_gather_columns(const char** srcs, const int64_t* row_bytes,
   });
 }
 
-int at_version() { return 1; }
+int at_version() { return 2; }
+
+}  // extern "C"
+
+#include <fcntl.h>
+#include <unistd.h>
+#include <cerrno>
+
+extern "C" {
+
+// Parallel positioned reads: dsts[i] receives sizes[i] bytes from
+// offsets[i] of `path`. The checkpoint-streaming hot path (L7/L8): one
+// safetensors shard holds hundreds of tensors, and per-tensor pread from
+// page cache is memcpy-bound — exactly what the pool parallelizes. Returns 0
+// on success, -errno of the first failed segment otherwise.
+int at_pread_segments(const char* path, const int64_t* offsets,
+                      const int64_t* sizes, char** dsts, int64_t n,
+                      int nthreads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  std::atomic<int> status{0};
+  parallel_for(n, nthreads, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t done = 0;
+      while (done < sizes[i]) {
+        ssize_t r = ::pread(fd, dsts[i] + done, sizes[i] - done, offsets[i] + done);
+        if (r <= 0) {
+          int err = r < 0 ? errno : EIO;
+          int expected = 0;
+          status.compare_exchange_strong(expected, -err);
+          return;
+        }
+        done += r;
+      }
+    }
+  });
+  ::close(fd);
+  return status.load();
+}
 
 }  // extern "C"

@@ -81,6 +81,13 @@ def save_safetensors(state_dict: Mapping[str, np.ndarray], path: str):
 
 
 def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    from ..native import load_safetensors_fast
+
+    # Parallel-pread native reader for big files (native/host_runtime.cpp
+    # at_pread_segments); safetensors lib otherwise.
+    loaded = load_safetensors_fast(path)
+    if loaded is not None:
+        return loaded
     from safetensors.numpy import load_file
 
     return load_file(path)

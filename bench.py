@@ -11,10 +11,12 @@ not actually block, and the first post-warmup step pays a second compile
 (donated-buffer layout), so the loop warms up twice and the barrier is a host
 fetch of the final loss — which transitively waits on every chained step.
 
-Attention runs the Pallas flash kernel (ops/pallas_flash.py) with the
-selective remat policy that saves the kernel's O(S) residuals and recomputes
-only the MLP — measured 46.9k tok/s/chip (MFU 0.573) vs 24.7k (MFU 0.302) for
-naive attention under plain remat on the same 334M model.
+Attention runs the Pallas flash kernel (ops/pallas_flash.py) under the
+"dots" remat policy (keep every matmul output + the kernel's O(S) residuals,
+recompute only elementwise ops) at batch 4 — the winner of
+benchmarks/ablate.py's policy x batch sweep: 51.5k tok/s/chip vs 46.8k for
+the flash-only policy at batch 8, vs 24.7k for naive attention under plain
+remat (same 334M model, seq 2048).
 """
 
 import json
@@ -41,9 +43,12 @@ def _pick_config(platform: str, seq: int):
                 max_position_embeddings=seq,
                 dtype=jnp.bfloat16,
                 remat=True,
+                remat_policy="dots",
                 attention_impl="flash",
             ),
-            8 if seq <= 2048 else 2,  # batch
+            # benchmarks/ablate.py sweep: "dots" wants the smaller batch
+            # (more VMEM headroom per step beats batch-level parallelism).
+            4 if seq <= 2048 else 1,  # batch
         )
     return LlamaConfig.tiny(dtype=jnp.bfloat16), 4
 
@@ -127,7 +132,7 @@ def main():
                 "value": round(tok, 1),
                 "unit": (
                     f"tokens/s/chip (bf16, {n_params/1e6:.0f}M params, seq 2048, "
-                    f"flash+selective-remat, MFU {mfu:.3f}{extra})"
+                    f"flash+dots-remat, MFU {mfu:.3f}{extra})"
                 ),
                 "vs_baseline": round(mfu / 0.45, 3),
             }

@@ -94,7 +94,15 @@ def training_function(args):
     if args.project_dir:
         accelerator.init_trackers("cv_example", config=vars(args))
 
-    module = ConvNet()
+    if args.arch == "resnet":
+        # The real CV family (models/resnet.py — ResNet-50 shape, BatchNorm
+        # with sync-BN semantics under the dp-sharded batch). tiny() keeps the
+        # example fast; swap for ResNetConfig.resnet50() on real data.
+        from accelerate_tpu.models import ResNet, ResNetConfig
+
+        module = ResNet(ResNetConfig.tiny(num_classes=NUM_CLASSES))
+    else:
+        module = ConvNet()
     train_ds = build_dataset(2048, seed=0)
     eval_ds = build_dataset(512, seed=1)
     sample = train_ds[0]
@@ -107,12 +115,22 @@ def training_function(args):
         LoaderSpec(eval_ds, args.batch_size, shuffle=False), schedule,
     )
 
-    def loss_fn(params, batch):
-        logits = module.apply({"params": params}, batch["images"])
-        labels = jax.nn.one_hot(batch["labels"], NUM_CLASSES)
-        return optax.softmax_cross_entropy(logits, labels).mean()
+    if args.arch == "resnet":
+        from accelerate_tpu.models import resnet_loss
 
-    step_fn = accelerator.prepare_train_step(loss_fn, max_grad_norm=1.0)
+        def loss_fn(params, extra, batch):
+            return resnet_loss(module, params, extra, batch["images"], batch["labels"])
+
+        step_fn = accelerator.prepare_train_step(
+            loss_fn, mutable_state=True, max_grad_norm=1.0
+        )
+    else:
+        def loss_fn(params, batch):
+            logits = module.apply({"params": params}, batch["images"])
+            labels = jax.nn.one_hot(batch["labels"], NUM_CLASSES)
+            return optax.softmax_cross_entropy(logits, labels).mean()
+
+        step_fn = accelerator.prepare_train_step(loss_fn, max_grad_norm=1.0)
     state = accelerator.train_state
 
     for epoch in range(args.epochs):
@@ -149,6 +167,7 @@ def training_function(args):
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--mixed_precision", type=str, default=None, choices=[None, "no", "bf16", "fp16"])
+    parser.add_argument("--arch", type=str, default="convnet", choices=["convnet", "resnet"])
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--lr", type=float, default=2e-3)

@@ -194,3 +194,43 @@ def test_notebook_launcher_refuses_live_backend():
     jax.devices()  # ensure the backend is up in this process
     with _pytest.raises(RuntimeError, match="already initialized"):
         notebook_launcher(lambda: None, num_processes=2)
+
+
+def test_cpu_offload_with_hook_chaining():
+    """Params stay chip-resident between forwards; offload() evicts; chaining
+    a prev hook evicts stage i-1 when stage i loads (reference pipeline
+    pattern, big_modeling.py:278-314)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu import Model, cpu_offload_with_hook
+
+    class Mlp(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(nn.relu(nn.Dense(16)(x)))
+
+    x = jnp.ones((2, 8))
+    m1 = Model.from_flax(Mlp(), jax.random.key(0), x)
+    m2 = Model.from_flax(Mlp(), jax.random.key(1), x)
+    dev = jax.devices()[0]
+    host = jax.local_devices(backend="cpu")[0]
+
+    m1h, hook1 = cpu_offload_with_hook(m1, execution_device=dev)
+    m2h, hook2 = cpu_offload_with_hook(m2, execution_device=dev, prev_module_hook=hook1)
+
+    def device_of(model):
+        return next(iter(jax.tree.leaves(model._params)[0].devices()))
+
+    assert device_of(m1h) == host
+    y = m2h(m1h(x))
+    assert y.shape == (2, 8)
+    # m1 was evicted by m2's load; m2 stays resident.
+    assert device_of(m1h) == host
+    assert device_of(m2h) == dev
+    hook2.offload()
+    assert device_of(m2h) == host
+    # Second pass still works and matches.
+    np.testing.assert_allclose(np.asarray(m2h(m1h(x))), np.asarray(y), rtol=1e-6)

@@ -173,3 +173,35 @@ def test_dispatcher_disables_prefetch_multiprocess():
     )
     assert isinstance(dl, DataLoaderDispatcher)
     assert dl.prefetch_size == 0  # explicit opt-out plumbs through
+
+
+def test_load_safetensors_fast_matches_library(tmp_path):
+    """Native parallel pread loader == safetensors lib, all dtypes incl bf16."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    from accelerate_tpu.native import load_safetensors_fast
+
+    rng = np.random.default_rng(0)
+    tensors = {
+        "a/f32": rng.normal(size=(64, 128)).astype(np.float32),
+        "b/bf16": rng.normal(size=(32, 16)).astype(ml_dtypes.bfloat16),
+        "c/i32": rng.integers(-5, 5, size=(7,)).astype(np.int32),
+        "d/scalarish": np.asarray([3.0], np.float32),
+    }
+    path = str(tmp_path / "m.safetensors")
+    save_file(tensors, path)
+    out = load_safetensors_fast(path, force=True)
+    assert out is not None, "native loader must engage when forced"
+    assert set(out) == set(tensors)
+    for k in tensors:
+        assert out[k].dtype == tensors[k].dtype
+        np.testing.assert_array_equal(
+            out[k].view(np.uint8), tensors[k].view(np.uint8), err_msg=k
+        )
+
+
+def test_load_safetensors_fast_missing_file():
+    from accelerate_tpu.native import load_safetensors_fast
+
+    assert load_safetensors_fast("/nonexistent/x.safetensors", force=True) is None
