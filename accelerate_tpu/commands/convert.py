@@ -1,0 +1,217 @@
+"""`accelerate-tpu convert-config` — migrate a reference accelerate YAML.
+
+The migration-tool role of the reference's `accelerate to-fsdp2`
+(reference: commands/to_fsdp2.py:82-127, which rewrites FSDP1 configs to
+FSDP2): here the conversion crosses frameworks — a HuggingFace
+`default_config.yaml` (any distributed_type: MULTI_GPU, FSDP, DEEPSPEED,
+TPU/XLA, plus parallelism_config) becomes an equivalent accelerate-tpu
+LaunchConfig YAML. Torch-only knobs with no TPU meaning (auto-wrap policies,
+NCCL timeouts, dynamo backends, ...) are reported as dropped rather than
+silently eaten.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from .config_args import LaunchConfig
+
+# Reference keys that intentionally have no analog here; listed so the report
+# can say "dropped (not meaningful on TPU)" instead of "unknown".
+_KNOWN_DROPPED = {
+    "compute_environment",  # recomputed
+    "debug",
+    "distributed_type",  # folded into degrees
+    "downcast_bf16",
+    "dynamo_config",
+    "enable_cpu_affinity",
+    "gpu_ids",
+    "machine_rank",
+    "megatron_lm_config",  # TP/PP/DP degrees map; engine knobs don't
+    "mpirun_config",
+    "rdzv_backend",
+    "same_network",
+    "tpu_env",
+    "tpu_use_cluster",
+    "tpu_use_sudo",
+    "use_cpu",
+    "ipex_config",
+    "fp8_config",
+}
+
+_FSDP_DROPPED = {
+    "fsdp_auto_wrap_policy",
+    "fsdp_transformer_layer_cls_to_wrap",
+    "fsdp_backward_prefetch",
+    "fsdp_forward_prefetch",
+    "fsdp_use_orig_params",
+    "fsdp_sync_module_states",
+    "fsdp_cpu_ram_efficient_loading",
+    "fsdp_min_num_params",
+    "fsdp_version",
+}
+
+
+def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
+    """Reference config dict → (LaunchConfig, report lines)."""
+    notes: list[str] = []
+    cfg = LaunchConfig()
+    dist = str(ref.get("distributed_type", "NO")).upper()
+    nproc = int(ref.get("num_processes", 1) or 1)
+    cfg.num_machines = int(ref.get("num_machines", 1) or 1)
+    cfg.machine_rank = int(ref.get("machine_rank", 0) or 0)
+    if ref.get("main_process_ip"):
+        cfg.main_process_ip = str(ref["main_process_ip"])
+    if ref.get("main_process_port"):
+        cfg.main_process_port = int(ref["main_process_port"])
+    mp = str(ref.get("mixed_precision", "no") or "no").lower()
+    cfg.mixed_precision = {"no": "no", "bf16": "bf16", "fp16": "fp16", "fp8": "fp8"}.get(mp, "no")
+    if mp == "fp16":
+        notes.append(
+            "mixed_precision fp16 kept (dynamic loss scaling) — consider bf16: "
+            "native on TPU, no scaler needed"
+        )
+    cfg.gradient_accumulation_steps = int(ref.get("gradient_accumulation_steps", 1) or 1)
+
+    # On TPU, processes = hosts; the reference's per-GPU workers collapse into
+    # one process per host addressing all local chips.
+    cfg.num_processes = max(cfg.num_machines, 1)
+    if cfg.num_machines > 1:
+        cfg.compute_environment = "TPU_POD"
+
+    if dist in ("MULTI_GPU", "MULTI_CPU", "MULTI_XPU", "MULTI_NPU", "MULTI_MLU", "TPU", "XLA"):
+        cfg.dp_replicate_size = nproc
+        notes.append(f"{dist} data-parallel over {nproc} workers → dp_replicate_size={nproc}")
+    elif dist == "FSDP":
+        f = ref.get("fsdp_config", {}) or {}
+        cfg.use_fsdp = True
+        strategy = str(f.get("fsdp_sharding_strategy", "FULL_SHARD")).upper()
+        # Accept the reference's numeric strategy encoding too (1-5).
+        strategy = {
+            "1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD",
+            "4": "HYBRID_SHARD", "5": "HYBRID_SHARD_ZERO2",
+        }.get(strategy, strategy)
+        if strategy == "NO_SHARD":
+            cfg.use_fsdp = False
+            cfg.dp_replicate_size = nproc
+            notes.append("NO_SHARD → plain data parallelism")
+        elif strategy.startswith("HYBRID_SHARD"):
+            cfg.fsdp_sharding_strategy = (
+                "SHARD_GRAD_OP" if strategy.endswith("ZERO2") else "FULL_SHARD"
+            )
+            # Without the reference's device_mesh we default to shard-within-
+            # host, replicate-across-hosts (the usual HSDP layout).
+            per = max(1, nproc // max(cfg.num_machines, 1))
+            cfg.dp_shard_size = per
+            cfg.dp_replicate_size = max(1, nproc // per)
+            notes.append(
+                f"HYBRID_SHARD → dp_replicate={cfg.dp_replicate_size} x "
+                f"dp_shard={cfg.dp_shard_size}"
+            )
+        else:
+            cfg.fsdp_sharding_strategy = strategy
+            cfg.dp_shard_size = nproc
+        cfg.fsdp_offload_params = bool(f.get("fsdp_offload_params", False))
+        cfg.fsdp_activation_checkpointing = bool(f.get("fsdp_activation_checkpointing", False))
+        if cfg.fsdp_activation_checkpointing:
+            cfg.remat_policy = "dots"
+            notes.append("fsdp_activation_checkpointing → remat_policy=dots")
+        sdt = str(f.get("fsdp_state_dict_type", "SHARDED_STATE_DICT")).upper()
+        cfg.fsdp_state_dict_type = (
+            "FULL_STATE_DICT" if sdt == "FULL_STATE_DICT" else "SHARDED_STATE_DICT"
+        )
+        for k in sorted(set(f) & _FSDP_DROPPED):
+            notes.append(f"dropped fsdp_config.{k} (no TPU analog: XLA SPMD has no wrap policies)")
+    elif dist == "DEEPSPEED":
+        d = ref.get("deepspeed_config", {}) or {}
+        stage = int(d.get("zero_stage", 2) or 0)
+        if stage >= 3:
+            cfg.use_fsdp = True
+            cfg.fsdp_sharding_strategy = "FULL_SHARD"
+            cfg.dp_shard_size = nproc
+            notes.append(f"ZeRO-{stage} → FULL_SHARD over dp_shard={nproc}")
+        elif stage in (1, 2):
+            cfg.use_fsdp = True
+            cfg.fsdp_sharding_strategy = "SHARD_GRAD_OP"
+            cfg.dp_shard_size = nproc
+            notes.append(f"ZeRO-{stage} → SHARD_GRAD_OP (sharded grads+opt state)")
+        else:
+            cfg.dp_replicate_size = nproc
+            notes.append("ZeRO-0 → plain data parallelism")
+        if str(d.get("offload_optimizer_device", "none")).lower() not in ("none", ""):
+            cfg.fsdp_offload_params = True
+            notes.append("offload_optimizer_device → fsdp_offload_params (host opt state)")
+        if d.get("gradient_accumulation_steps") not in (None, "auto"):
+            cfg.gradient_accumulation_steps = int(d["gradient_accumulation_steps"])
+        if d.get("gradient_clipping") not in (None, "auto"):
+            notes.append(
+                f"gradient_clipping={d['gradient_clipping']} → pass max_grad_norm to "
+                "prepare_train_step / clip_grad_norm_"
+            )
+    elif dist in ("NO",):
+        pass
+    elif dist == "MEGATRON_LM":
+        m = ref.get("megatron_lm_config", {}) or {}
+        cfg.tp_size = int(m.get("megatron_lm_tp_degree", 1) or 1)
+        cfg.pp_size = int(m.get("megatron_lm_pp_degree", 1) or 1)
+        rest = nproc // max(cfg.tp_size * cfg.pp_size, 1)
+        cfg.dp_replicate_size = max(1, rest)
+        notes.append(
+            f"MEGATRON_LM → tp={cfg.tp_size} x pp={cfg.pp_size} x dp={cfg.dp_replicate_size} "
+            "(native mesh axes; Megatron engine knobs dropped)"
+        )
+    else:
+        notes.append(f"unsupported distributed_type {dist!r}: kept single-process defaults")
+
+    # Reference ParallelismConfig block maps 1:1 onto our mesh degrees.
+    pc = ref.get("parallelism_config", {}) or {}
+    for ref_key, ours in [
+        ("parallelism_config_dp_replicate_size", "dp_replicate_size"),
+        ("parallelism_config_dp_shard_size", "dp_shard_size"),
+        ("parallelism_config_tp_size", "tp_size"),
+        ("parallelism_config_cp_size", "cp_size"),
+        ("parallelism_config_sp_size", "sp_size"),
+    ]:
+        if ref_key in pc:
+            setattr(cfg, ours, int(pc[ref_key]))
+    if pc:
+        notes.append("parallelism_config degrees copied onto the mesh axes")
+
+    handled = {
+        "num_processes", "num_machines", "machine_rank", "main_process_ip",
+        "main_process_port", "mixed_precision", "gradient_accumulation_steps",
+        "fsdp_config", "deepspeed_config", "parallelism_config",
+    }
+    for k in sorted(set(ref) - handled - _KNOWN_DROPPED):
+        notes.append(f"unknown key {k!r} dropped")
+    return cfg, notes
+
+
+def convert_command(args) -> int:
+    import yaml
+
+    with open(args.input) as f:
+        ref = yaml.safe_load(f) or {}
+    cfg, notes = convert_reference_config(ref)
+    payload = dataclasses.asdict(cfg)
+    out = args.output
+    if out:
+        with open(out, "w") as f:
+            yaml.safe_dump(payload, f, sort_keys=False)
+        print(f"wrote {out}")
+    else:
+        print(yaml.safe_dump(payload, sort_keys=False))
+    for n in notes:
+        print(f"  note: {n}")
+    return 0
+
+
+def add_parser(subparsers):
+    p = subparsers.add_parser(
+        "convert-config",
+        help="Convert a HuggingFace accelerate default_config.yaml to an accelerate-tpu config",
+    )
+    p.add_argument("input", help="Path to the reference accelerate YAML")
+    p.add_argument("-o", "--output", default=None, help="Output path (stdout if omitted)")
+    p.set_defaults(func=convert_command)

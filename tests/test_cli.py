@@ -263,3 +263,62 @@ def test_elastic_restart_recovers(tmp_path):
     assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
     assert "restarting gang" in r.stderr
     assert "attempt=1 rank=0 ok" in r.stdout
+
+
+def test_convert_config_fsdp(tmp_path, capsys):
+    """Reference FSDP yaml → our LaunchConfig yaml (to-fsdp2 migration role)."""
+    import yaml
+
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    ref = {
+        "distributed_type": "FSDP",
+        "mixed_precision": "bf16",
+        "num_processes": 8,
+        "fsdp_config": {
+            "fsdp_sharding_strategy": "FULL_SHARD",
+            "fsdp_activation_checkpointing": True,
+            "fsdp_offload_params": False,
+            "fsdp_state_dict_type": "SHARDED_STATE_DICT",
+            "fsdp_auto_wrap_policy": "TRANSFORMER_BASED_WRAP",
+        },
+    }
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump(ref))
+    out = tmp_path / "ours.yaml"
+    assert main(["convert-config", str(src), "-o", str(out)]) == 0
+    got = yaml.safe_load(out.read_text())
+    assert got["use_fsdp"] is True
+    assert got["dp_shard_size"] == 8
+    assert got["mixed_precision"] == "bf16"
+    assert got["fsdp_activation_checkpointing"] is True
+    assert got["remat_policy"] == "dots"
+    notes = capsys.readouterr().out
+    assert "fsdp_auto_wrap_policy" in notes  # dropped keys are reported
+
+
+def test_convert_config_deepspeed_and_hybrid(tmp_path):
+    import yaml
+
+    from accelerate_tpu.commands.convert import convert_reference_config
+
+    cfg, notes = convert_reference_config({
+        "distributed_type": "DEEPSPEED",
+        "num_processes": 16,
+        "deepspeed_config": {"zero_stage": 2, "offload_optimizer_device": "cpu"},
+    })
+    assert cfg.use_fsdp and cfg.fsdp_sharding_strategy == "SHARD_GRAD_OP"
+    assert cfg.dp_shard_size == 16 and cfg.fsdp_offload_params
+
+    cfg, _ = convert_reference_config({
+        "distributed_type": "FSDP",
+        "num_processes": 16,
+        "num_machines": 2,
+        "fsdp_config": {"fsdp_sharding_strategy": "HYBRID_SHARD"},
+    })
+    assert cfg.dp_shard_size == 8 and cfg.dp_replicate_size == 2
+
+    cfg, _ = convert_reference_config({
+        "distributed_type": "MULTI_GPU", "num_processes": 4,
+    })
+    assert cfg.dp_replicate_size == 4 and not cfg.use_fsdp
