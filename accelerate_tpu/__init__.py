@@ -59,3 +59,11 @@ from .inference import (  # noqa: E402
     prepare_pippy,
     register_pipeline_plan,
 )
+from .generation import (  # noqa: E402
+    GenerationConfig,
+    KVCache,
+    generate,
+    init_cache,
+    register_generation_plan,
+    sample_logits,
+)

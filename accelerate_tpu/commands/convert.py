@@ -85,8 +85,18 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
         notes.append(f"{dist} data-parallel over {nproc} workers → dp_replicate_size={nproc}")
     elif dist == "FSDP":
         f = ref.get("fsdp_config", {}) or {}
+        consumed = {
+            "fsdp_sharding_strategy", "fsdp_offload_params",
+            "fsdp_activation_checkpointing", "fsdp_state_dict_type",
+            "fsdp_reshard_after_forward", "fsdp_version",
+        }
         cfg.use_fsdp = True
         strategy = str(f.get("fsdp_sharding_strategy", "FULL_SHARD")).upper()
+        # FSDP2 spells ZeRO-2 as reshard_after_forward=False (no
+        # sharding_strategy key at all).
+        if "fsdp_sharding_strategy" not in f and f.get("fsdp_reshard_after_forward") is False:
+            strategy = "SHARD_GRAD_OP"
+            notes.append("fsdp_reshard_after_forward=false → SHARD_GRAD_OP (ZeRO-2 memory)")
         # Accept the reference's numeric strategy encoding too (1-5).
         strategy = {
             "1": "FULL_SHARD", "2": "SHARD_GRAD_OP", "3": "NO_SHARD",
@@ -123,8 +133,14 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
         )
         for k in sorted(set(f) & _FSDP_DROPPED):
             notes.append(f"dropped fsdp_config.{k} (no TPU analog: XLA SPMD has no wrap policies)")
+        for k in sorted(set(f) - consumed - _FSDP_DROPPED):
+            notes.append(f"unknown key fsdp_config.{k!r} dropped")
     elif dist == "DEEPSPEED":
         d = ref.get("deepspeed_config", {}) or {}
+        ds_consumed = {
+            "zero_stage", "offload_optimizer_device", "offload_param_device",
+            "gradient_accumulation_steps", "gradient_clipping",
+        }
         stage = int(d.get("zero_stage", 2) or 0)
         if stage >= 3:
             cfg.use_fsdp = True
@@ -139,9 +155,13 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
         else:
             cfg.dp_replicate_size = nproc
             notes.append("ZeRO-0 → plain data parallelism")
-        if str(d.get("offload_optimizer_device", "none")).lower() not in ("none", ""):
+        offloads = {
+            str(d.get("offload_optimizer_device", "none")).lower(),
+            str(d.get("offload_param_device", "none")).lower(),
+        } - {"none", ""}
+        if offloads:
             cfg.fsdp_offload_params = True
-            notes.append("offload_optimizer_device → fsdp_offload_params (host opt state)")
+            notes.append("offload_*_device → fsdp_offload_params (host opt state)")
         if d.get("gradient_accumulation_steps") not in (None, "auto"):
             cfg.gradient_accumulation_steps = int(d["gradient_accumulation_steps"])
         if d.get("gradient_clipping") not in (None, "auto"):
@@ -149,6 +169,8 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
                 f"gradient_clipping={d['gradient_clipping']} → pass max_grad_norm to "
                 "prepare_train_step / clip_grad_norm_"
             )
+        for k in sorted(set(d) - ds_consumed):
+            notes.append(f"unknown key deepspeed_config.{k!r} dropped")
     elif dist in ("NO",):
         pass
     elif dist == "MEGATRON_LM":
@@ -166,17 +188,20 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
 
     # Reference ParallelismConfig block maps 1:1 onto our mesh degrees.
     pc = ref.get("parallelism_config", {}) or {}
-    for ref_key, ours in [
+    pc_map = [
         ("parallelism_config_dp_replicate_size", "dp_replicate_size"),
         ("parallelism_config_dp_shard_size", "dp_shard_size"),
         ("parallelism_config_tp_size", "tp_size"),
         ("parallelism_config_cp_size", "cp_size"),
         ("parallelism_config_sp_size", "sp_size"),
-    ]:
+    ]
+    for ref_key, ours in pc_map:
         if ref_key in pc:
             setattr(cfg, ours, int(pc[ref_key]))
     if pc:
         notes.append("parallelism_config degrees copied onto the mesh axes")
+    for k in sorted(set(pc) - {rk for rk, _ in pc_map}):
+        notes.append(f"unknown key parallelism_config.{k!r} dropped")
 
     handled = {
         "num_processes", "num_machines", "machine_rank", "main_process_ip",
@@ -195,15 +220,19 @@ def convert_command(args) -> int:
         ref = yaml.safe_load(f) or {}
     cfg, notes = convert_reference_config(ref)
     payload = dataclasses.asdict(cfg)
+    import sys
+
     out = args.output
     if out:
         with open(out, "w") as f:
             yaml.safe_dump(payload, f, sort_keys=False)
-        print(f"wrote {out}")
+        print(f"wrote {out}", file=sys.stderr)
     else:
+        # YAML on stdout so `convert-config ref.yaml > tpu.yaml` works;
+        # everything else on stderr.
         print(yaml.safe_dump(payload, sort_keys=False))
     for n in notes:
-        print(f"  note: {n}")
+        print(f"  note: {n}", file=sys.stderr)
     return 0
 
 

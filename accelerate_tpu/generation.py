@@ -1,0 +1,325 @@
+"""Autoregressive generation with a KV cache.
+
+The reference delegates generation to transformers' ``model.generate`` over
+its wrapped modules (its big-model benchmarks are generate loops —
+reference: benchmarks/big_model_inference/README.md). A TPU-native framework
+owns the loop: a static-shape KV cache, ONE jitted decode step reused for
+every token (no per-position recompiles), and RoPE/GQA handled at the cache
+level.
+
+Design:
+
+- The cache is an explicit pytree ``(k, v)`` of shape ``(L, B, T_max, Hkv, D)``
+  threaded through pure functions — no flax mutable collections, so the same
+  code runs under ``jit``, ``shard_map``, and the big-model streaming path.
+- ``prefill`` runs the prompt through a ``lax.scan`` over the stacked layer
+  params (the ``nn.scan`` weight layout IS the cache layout) and writes each
+  layer's rotated K/V; ``decode_step`` attends one query against the cache
+  with a static-shape position mask.
+- Attention math mirrors models/llama.py exactly (RMSNorm → fused QKV
+  projections → RoPE at absolute positions → GQA by head repetition → SwiGLU
+  MLP); parity with ``module.apply`` is pinned by tests/test_generation.py.
+- Sampling: greedy, temperature, top-k, nucleus (top-p) — composable, jitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.llama import apply_rope, rms_norm, rotary_embedding
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, T_max, Hkv, D)
+    v: jax.Array  # (L, B, T_max, Hkv, D)
+    length: jax.Array  # () int32 — tokens written so far
+
+
+def _cache_dims(cfg) -> tuple[int, int, int, int]:
+    """(layers, kv_heads, head_dim, max_positions) for any supported config."""
+    layers = getattr(cfg, "num_hidden_layers", None) or cfg.n_layer
+    kv_heads = (
+        getattr(cfg, "num_key_value_heads", None)
+        or getattr(cfg, "num_attention_heads", None)
+        or cfg.n_head
+    )
+    max_pos = getattr(cfg, "max_position_embeddings", None) or cfg.n_positions
+    return layers, kv_heads, cfg.head_dim, max_pos
+
+
+def init_cache(cfg, batch: int, max_len: int, dtype=None) -> KVCache:
+    layers, kv_heads, head_dim, _ = _cache_dims(cfg)
+    shape = (layers, batch, max_len, kv_heads, head_dim)
+    dtype = dtype or cfg.dtype
+    return KVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Llama block math on raw param trees (stacked nn.scan layout)
+# ---------------------------------------------------------------------------
+
+
+def _proj(x, kernel):
+    # kernel (H, heads, D) — the DenseGeneral layout of models/llama.py.
+    return jnp.einsum("bsh,hnd->bsnd", x, kernel.astype(x.dtype))
+
+
+def _out_proj(x, kernel):
+    # kernel (heads, D, H).
+    return jnp.einsum("bsnd,ndh->bsh", x, kernel.astype(x.dtype))
+
+
+def _mlp(cfg, p, x):
+    gate = x @ p["gate_proj"]["kernel"].astype(x.dtype)
+    up = x @ p["up_proj"]["kernel"].astype(x.dtype)
+    return (jax.nn.silu(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
+
+
+def _attend(q, k, v, q_positions):
+    """q (B,Sq,Hq,D) vs cached k/v (B,T,Hkv,D); causal wrt absolute positions.
+    The causal bound kv_pos <= q_position also excludes unwritten cache slots
+    (every query position is < cache length after the write)."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    t = k.shape[1]
+    kv_pos = jnp.arange(t, dtype=jnp.int32)[None, :]  # (1, T)
+    causal = kv_pos[None, :, :] <= q_positions[:, :, None]  # (B, Sq, T)
+    logits = jnp.where(causal[:, None], logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _llama_forward_cached(cfg, params, input_ids, cache: KVCache):
+    """Run ``input_ids`` (appended at cache.length) through all layers,
+    returning (logits_for_last_token, new_cache)."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    model_p = params["model"] if "model" in params else params
+    stacked = model_p["layers"]["block"]
+    embed = model_p["embed_tokens"]["embedding"]
+
+    b, s = input_ids.shape
+    t_max = cache.k.shape[2]
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
+        attn = p["self_attn"]
+        hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        q = apply_rope(_proj(hn, attn["q_proj"]["kernel"]), cos, sin)
+        k_new = apply_rope(_proj(hn, attn["k_proj"]["kernel"]), cos, sin)
+        v_new = _proj(hn, attn["v_proj"]["kernel"])
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions)
+        h = h + _out_proj(out, attn["o_proj"]["kernel"])
+        hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        h = h + _mlp(cfg, p["mlp"], hn)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
+    x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), cfg.rms_norm_eps)
+    last = x[:, -1]
+    if cfg.tie_word_embeddings:
+        logits = last @ embed.T.astype(cfg.dtype)
+    else:
+        logits = last @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
+# ---------------------------------------------------------------------------
+# Sampling
+# ---------------------------------------------------------------------------
+
+
+def sample_logits(logits, rng, *, temperature=1.0, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """(B, V) fp32 logits → (B,) token ids. temperature<=0 means greedy."""
+    if temperature is None or temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        top_k = min(top_k, logits.shape[-1])  # transformers clamps too
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass >= top_p (always >= 1 tok).
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# The loop
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, p, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache):
+    """GPT-2 decode with the same cache contract (learned positions, fused
+    c_attn, GELU MLP — mirrors models/gpt2.py)."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    tr = params["transformer"]
+    stacked = tr["h"]["block"]
+    wte = tr["wte"]["embedding"]
+
+    b, s = input_ids.shape
+    t_max = cache.k.shape[2]
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions_b = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+    x = x + jnp.take(tr["wpe"]["embedding"], positions[0], axis=0).astype(cfg.dtype)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv = layer
+        hn = _layer_norm(h, p["ln_1"], cfg.layer_norm_epsilon)
+        qkv = jnp.einsum(
+            "bsh,hcnd->bscnd", hn, p["attn"]["c_attn"]["kernel"].astype(hn.dtype)
+        ) + p["attn"]["c_attn"]["bias"].astype(hn.dtype)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions_b)
+        h = h + (
+            jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["c_proj"]["kernel"].astype(out.dtype))
+            + p["attn"]["c_proj"]["bias"].astype(out.dtype)
+        )
+        hn = _layer_norm(h, p["ln_2"], cfg.layer_norm_epsilon)
+        mid = jax.nn.gelu(
+            hn @ p["c_fc"]["kernel"].astype(hn.dtype) + p["c_fc"]["bias"].astype(hn.dtype)
+        )
+        h = h + mid @ p["c_proj"]["kernel"].astype(mid.dtype) + p["c_proj"]["bias"].astype(mid.dtype)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
+    x = _layer_norm(x, tr["ln_f"], cfg.layer_norm_epsilon)
+    logits = x[:, -1] @ wte.T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
+# module class name -> forward_cached(cfg, params, ids, cache)
+GENERATION_PLANS: dict[str, Callable] = {
+    "LlamaForCausalLM": _llama_forward_cached,
+    "GPT2LMHeadModel": _gpt2_forward_cached,
+}
+
+
+def register_generation_plan(module_class_name: str, fn: Callable) -> None:
+    GENERATION_PLANS[module_class_name] = fn
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Bundled sampling settings; ``generate(..., config=GenerationConfig(...))``
+    uses these as defaults, explicit kwargs win."""
+
+    max_new_tokens: int = 32
+    temperature: float = 0.0        # 0 → greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: Optional[int] = None  # finished rows get this (default: eos)
+
+
+def generate(
+    model,
+    input_ids,
+    max_new_tokens: Optional[int] = None,
+    *,
+    temperature: Optional[float] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+    forward_cached: Optional[Callable] = None,
+    config: Optional[GenerationConfig] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations for ``input_ids`` (B, S).
+
+    One jitted prefill + one jitted decode step (compiled once, reused every
+    token). Returns (B, S + max_new_tokens); after a row emits
+    ``eos_token_id`` it is padded with ``pad_token_id`` (defaulting to the
+    EOS id, like transformers' warning-fallback).
+    """
+    gc = config or GenerationConfig()
+    max_new_tokens = gc.max_new_tokens if max_new_tokens is None else max_new_tokens
+    temperature = gc.temperature if temperature is None else temperature
+    top_k = top_k if top_k is not None else gc.top_k
+    top_p = top_p if top_p is not None else gc.top_p
+    eos_token_id = eos_token_id if eos_token_id is not None else gc.eos_token_id
+    pad_token_id = pad_token_id if pad_token_id is not None else gc.pad_token_id
+    if pad_token_id is None:
+        pad_token_id = eos_token_id
+    cfg = model.module.config
+    params = model.params
+    fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
+    if fwd is None:
+        known = ", ".join(sorted(GENERATION_PLANS))
+        raise ValueError(
+            f"No generation plan for {type(model.module).__name__!r}; built-in: {known}"
+        )
+    input_ids = jnp.asarray(input_ids)
+    b, s = input_ids.shape
+    t_max = s + max_new_tokens
+    max_pos = _cache_dims(cfg)[3]
+    if t_max > max_pos:
+        raise ValueError(
+            f"{t_max} tokens exceeds max_position_embeddings={max_pos}"
+        )
+    rng = rng if rng is not None else jax.random.key(0)
+
+    cache = init_cache(cfg, b, t_max)
+    prefill = jax.jit(partial(fwd, cfg))
+    logits, cache = prefill(params, input_ids, cache)
+
+    sample = partial(sample_logits, temperature=temperature, top_k=top_k, top_p=top_p)
+
+    def step(carry, _):
+        cache, logits, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        tok = sample(logits, sub)
+        if eos_token_id is not None:
+            tok = jnp.where(done, pad_token_id, tok)
+            done = done | (tok == eos_token_id)
+        logits, cache = fwd(cfg, params, tok[:, None], cache)
+        return (cache, logits, rng, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (cache, logits, rng, done0), None, length=max_new_tokens
+    )
+    return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)

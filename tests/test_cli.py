@@ -293,8 +293,8 @@ def test_convert_config_fsdp(tmp_path, capsys):
     assert got["mixed_precision"] == "bf16"
     assert got["fsdp_activation_checkpointing"] is True
     assert got["remat_policy"] == "dots"
-    notes = capsys.readouterr().out
-    assert "fsdp_auto_wrap_policy" in notes  # dropped keys are reported
+    notes = capsys.readouterr().err
+    assert "fsdp_auto_wrap_policy" in notes  # dropped keys are reported (stderr)
 
 
 def test_convert_config_deepspeed_and_hybrid(tmp_path):
@@ -322,3 +322,17 @@ def test_convert_config_deepspeed_and_hybrid(tmp_path):
         "distributed_type": "MULTI_GPU", "num_processes": 4,
     })
     assert cfg.dp_replicate_size == 4 and not cfg.use_fsdp
+
+
+def test_convert_config_fsdp2_and_unknown_subkeys():
+    from accelerate_tpu.commands.convert import convert_reference_config
+
+    cfg, notes = convert_reference_config({
+        "distributed_type": "FSDP",
+        "num_processes": 4,
+        "fsdp_config": {"fsdp_version": 2, "fsdp_reshard_after_forward": False,
+                        "fsdp_mystery_knob": 1},
+    })
+    assert cfg.fsdp_sharding_strategy == "SHARD_GRAD_OP"
+    joined = "\n".join(notes)
+    assert "fsdp_mystery_knob" in joined  # unknown sub-keys reported
