@@ -52,3 +52,9 @@ from .resnet import (
     ResNetConfig,
     resnet_loss,
 )
+from .vit import (
+    ViTConfig,
+    ViTForImageClassification,
+    ViTModel,
+    vit_tp_rules,
+)

@@ -353,6 +353,71 @@ def bert_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# ViT
+# ---------------------------------------------------------------------------
+
+def vit_config_from_hf(hf: Any) -> "ViTConfig":
+    from .vit import ViTConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return ViTConfig(
+        image_size=g("image_size", 224),
+        patch_size=g("patch_size", 16),
+        num_channels=g("num_channels", 3),
+        hidden_size=g("hidden_size"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        intermediate_size=g("intermediate_size"),
+        layer_norm_eps=g("layer_norm_eps", 1e-12),
+        num_labels=g("num_labels", 1000),
+    )
+
+
+def vit_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, d = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    pref = "vit." if any(k.startswith("vit.") for k in sd) else ""
+    e = pref + "embeddings."
+    tree: dict = {"vit": {}}
+    _set(tree, "vit/cls_token", _np(sd[e + "cls_token"]))
+    _set(tree, "vit/position_embeddings", _np(sd[e + "position_embeddings"]))
+    # torch Conv2d kernel (H, C, P, P) → flax NHWC Conv kernel (P, P, C, H).
+    conv = _np(sd[e + "patch_embeddings.projection.weight"]).transpose(2, 3, 1, 0)
+    _set(tree, "vit/patch_embed/kernel", conv)
+    _set(tree, "vit/patch_embed/bias", _np(sd[e + "patch_embeddings.projection.bias"]))
+    _set(tree, "vit/ln_final/scale", _np(sd[pref + "layernorm.weight"]))
+    _set(tree, "vit/ln_final/bias", _np(sd[pref + "layernorm.bias"]))
+    if "classifier.weight" in sd:
+        _set(tree, "classifier/kernel", _t(sd["classifier.weight"]))
+        _set(tree, "classifier/bias", _np(sd["classifier.bias"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pref}encoder.layer.{i}."
+        layers.append({
+            "ln_before/scale": _np(sd[p + "layernorm_before.weight"]),
+            "ln_before/bias": _np(sd[p + "layernorm_before.bias"]),
+            "attention/query/kernel": _t(sd[p + "attention.attention.query.weight"]).reshape(h, nh, d),
+            "attention/query/bias": _np(sd[p + "attention.attention.query.bias"]).reshape(nh, d),
+            "attention/key/kernel": _t(sd[p + "attention.attention.key.weight"]).reshape(h, nh, d),
+            "attention/key/bias": _np(sd[p + "attention.attention.key.bias"]).reshape(nh, d),
+            "attention/value/kernel": _t(sd[p + "attention.attention.value.weight"]).reshape(h, nh, d),
+            "attention/value/bias": _np(sd[p + "attention.attention.value.bias"]).reshape(nh, d),
+            "attention/output/kernel": _t(sd[p + "attention.output.dense.weight"]).reshape(nh, d, h),
+            "attention/output/bias": _np(sd[p + "attention.output.dense.bias"]),
+            "ln_after/scale": _np(sd[p + "layernorm_after.weight"]),
+            "ln_after/bias": _np(sd[p + "layernorm_after.bias"]),
+            "intermediate/kernel": _t(sd[p + "intermediate.dense.weight"]),
+            "intermediate/bias": _np(sd[p + "intermediate.dense.bias"]),
+            "output/kernel": _t(sd[p + "output.dense.weight"]),
+            "output/bias": _np(sd[p + "output.dense.bias"]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "vit/layers/block", "vit/layer_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # T5
 # ---------------------------------------------------------------------------
 
@@ -445,6 +510,7 @@ _FAMILIES = {
     "gpt2": ("GPT2LMHeadModel", gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),
     "t5": ("T5ForConditionalGeneration", t5_config_from_hf, t5_params_from_hf),
+    "vit": ("ViTForImageClassification", vit_config_from_hf, vit_params_from_hf),
 }
 
 

@@ -1,0 +1,179 @@
+"""ViT-family image classifier, TPU-first.
+
+Vision Transformer with the same design points as the text families
+(models/bert.py, models/llama.py): fused per-head DenseGeneral projections
+shaped for the MXU, optional ``nn.scan`` over identical blocks, optional
+remat, a Megatron-style TP rule table, bf16 compute with fp32 params. The
+patch embedding is a single strided conv (NHWC — the layout XLA:TPU tiles
+best); classification reads the CLS token through the final LayerNorm, the
+standard ViT head. HF ``ViTForImageClassification`` checkpoints load via
+models/hub.py with tested logit parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 1000
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128, num_labels=4,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def vit_base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def vit_large(cls, **kw):
+        return cls(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+                   intermediate_size=4096, **kw)
+
+
+class ViTSelfAttention(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = cfg.head_dim
+        dense = partial(
+            nn.DenseGeneral, features=(cfg.num_attention_heads, d), dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+        )
+        q = dense(name="query")(x)
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="output",
+        )(out)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer encoder block (the ViT convention)."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_before")(x)
+        x = x + ViTSelfAttention(cfg, name="attention")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_after")(x)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = dense(cfg.intermediate_size, name="intermediate")(h)
+        h = nn.gelu(h, approximate=False)  # exact erf GELU (ViT convention)
+        return x + dense(cfg.hidden_size, name="output")(h)
+
+
+class _ScannedViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return ViTBlock(self.config, name="block")(x), None
+
+
+class ViTModel(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        """pixel_values: (B, H, W, C) NHWC → (B, N+1, hidden)."""
+        cfg = self.config
+        x = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            dtype=cfg.dtype, param_dtype=jnp.float32, name="patch_embed",
+        )(pixel_values.astype(cfg.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_size)  # (B, N, H)
+        cls = self.param(
+            "cls_token", nn.initializers.truncated_normal(0.02),
+            (1, 1, cfg.hidden_size), jnp.float32,
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(x.dtype), (b, 1, cfg.hidden_size)), x], 1)
+        pos = self.param(
+            "position_embeddings", nn.initializers.truncated_normal(0.02),
+            (1, cfg.num_patches + 1, cfg.hidden_size), jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+
+        block_cls = _ScannedViTBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, name="layers")(x, None)
+        else:
+            blk = nn.remat(ViTBlock, prevent_cse=False) if cfg.remat else ViTBlock
+            for i in range(cfg.num_hidden_layers):
+                x = blk(cfg, name=f"layer_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_final")(x)
+
+
+class ViTForImageClassification(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        cfg = self.config
+        x = ViTModel(cfg, name="vit")(pixel_values)
+        return nn.Dense(
+            cfg.num_labels, dtype=jnp.float32, param_dtype=jnp.float32, name="classifier"
+        )(x[:, 0]).astype(jnp.float32)
+
+
+def vit_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    """Megatron column/row-parallel table for ViT (same shape as BERT's)."""
+    lead = (None,) if scan_layers else ()
+    return [
+        (r"attention/(query|key|value)/kernel", lead + (None, "tp", None)),
+        (r"attention/output/kernel", lead + ("tp", None, None)),
+        (r"intermediate/kernel", lead + (None, "tp")),
+        (r"(?<!attention/)output/kernel", lead + ("tp", None)),
+    ]
