@@ -199,6 +199,54 @@ def _llama_stream_forward(module, resolver: ParamResolver, input_ids):
 register_stream_plan("LlamaForCausalLM", _llama_stream_forward)
 
 
+def _opt_stream_forward(module, resolver: ParamResolver, input_ids):
+    """Layer-streamed OPT forward — the reference's OPT-30B big-model-inference
+    workload (benchmarks/big_model_inference/README.md) with ≤2 blocks in HBM."""
+    import flax.linen as nn
+
+    from .models.opt import OPTBlock
+
+    cfg = module.config
+    input_ids = jnp.asarray(input_ids)
+
+    embed_params = resolver.peek("model/embed_tokens")  # reused by the tied head
+    embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32)
+    x = _jit_for((cfg, "embed"), lambda p, ids: embed.apply({"params": p}, ids))(
+        embed_params, input_ids
+    )
+    pos_embed = nn.Embed(
+        cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.hidden_size,
+        dtype=cfg.dtype, param_dtype=jnp.float32,
+    )
+    positions = jnp.arange(input_ids.shape[-1]) + cfg.POSITION_OFFSET
+    x = x + _jit_for((cfg, "pos"), lambda p, i: pos_embed.apply({"params": p}, i))(
+        resolver.take("model/embed_positions"), positions
+    )
+
+    block = OPTBlock(cfg)
+    block_fn = _jit_for((cfg, "block"), lambda p, h: block.apply({"params": p}, h))
+    if cfg.scan_layers:
+        layer_args = [("model/layers/block", i) for i in range(cfg.num_hidden_layers)]
+    else:
+        layer_args = [(f"model/layer_{i}", None) for i in range(cfg.num_hidden_layers)]
+    resolver.prefetch(*layer_args[0])
+    for i, (prefix, idx) in enumerate(layer_args):
+        if i + 1 < len(layer_args):
+            resolver.prefetch(*layer_args[i + 1])
+        x = block_fn(resolver.take(prefix, idx), x)
+
+    ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps)
+    x = _jit_for((cfg, "ln_f"), lambda p, h: ln.apply({"params": p}, h))(
+        resolver.take("model/final_layer_norm"), x
+    )
+    w = resolver.take("model/embed_tokens")["embedding"]
+    return _jit_for((cfg, "tied_head"), lambda w, h: (h @ w.T.astype(cfg.dtype)))(w, x)
+
+
+register_stream_plan("OPTForCausalLM", _opt_stream_forward)
+
+
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------

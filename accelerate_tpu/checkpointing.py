@@ -59,61 +59,10 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = Fal
     return output_dir
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
-    pc = accelerator.project_configuration
-    output_dir = _checkpoint_dir(accelerator, output_dir)
-    if pc.automatic_checkpoint_naming and accelerator.is_main_process:
-        base = os.path.dirname(output_dir)
-        os.makedirs(base, exist_ok=True)
-        existing = sorted(
-            (f for f in os.listdir(base) if f.startswith("checkpoint_")),
-            key=lambda f: int(f.split("_")[1]),
-        )
-        # total_limit pruning (reference: accelerator.py:3622-3647).
-        if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
-            import shutil
-
-            for f in existing[: len(existing) + 1 - pc.total_limit]:
-                shutil.rmtree(os.path.join(base, f), ignore_errors=True)
-    accelerator.wait_for_everyone()
-    os.makedirs(output_dir, exist_ok=True)
-
-    state = accelerator._train_state
-    if state is None:
-        raise RuntimeError("Nothing prepared; call accelerator.prepare(...) first.")
-
-    # Model params → name-keyed safetensors (fp32 masters, gathered to host).
-    # fsdp_plugin.state_dict_type picks the file layout (reference:
-    # FULL_STATE_DICT = one file, SHARDED_STATE_DICT = size-split shards +
-    # index, utils/fsdp_utils.py:103-337); both are name-keyed and
-    # reshard-safe, so either loads into any mesh.
-    plugin = getattr(accelerator, "fsdp_plugin", None)
-    max_shard = (
-        "5GB" if plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT" else 10**15
-    )
-    params_host = to_global_host(state.params)
+def _save_host_side_state(accelerator, state, output_dir: str) -> None:
+    """Scheduler / dataloader / custom-object / step / scaler / per-rank RNG —
+    the non-tensor sidecar files shared by both checkpoint formats."""
     if accelerator.is_main_process:
-        save_sharded_safetensors(
-            flatten_state_dict(params_host), output_dir,
-            max_shard_size=max_shard, weights_name=f"{MODEL_NAME}.safetensors",
-        )
-
-    # Optimizer state: flattened name-keyed arrays + treedef-free aux.
-    opt_host = jax.tree.map(
-        lambda x: to_global_host(x) if hasattr(x, 'shape') else x, state.opt_state
-    )
-    step_host = int(np.asarray(state.step))
-    # Non-param collections (flax batch_stats etc.) ride along so BatchNorm
-    # models resume with their running statistics.
-    extra_host = (
-        jax.tree.map(to_global_host, state.extra_state)
-        if state.extra_state else None
-    )
-    if accelerator.is_main_process:
-        with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
-            pickle.dump(
-                {"opt_state": opt_host, "step": step_host, "extra_state": extra_host}, f
-            )
         if state.loss_scale is not None:
             with open(os.path.join(output_dir, f"{SCALER_NAME}.bin"), "wb") as f:
                 pickle.dump(
@@ -155,6 +104,138 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     ) as f:
         pickle.dump(rng_state(), f)
 
+
+_ORBAX_DIR = "distributed_state"
+
+
+def _orbax_payload(state) -> dict:
+    payload = {"params": state.params, "opt_state": state.opt_state, "step": state.step}
+    if state.extra_state:
+        payload["extra_state"] = state.extra_state
+    return payload
+
+
+def _save_distributed_state(accelerator, state, output_dir: str) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(output_dir, _ORBAX_DIR))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, _orbax_payload(state), force=True)
+
+
+def _load_distributed_state(accelerator, state, input_dir: str):
+    """Restore straight to the live mesh's shardings — each process reads only
+    the byte ranges its shards need (TensorStore), no host gather inverse."""
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    shardings = accelerator._state_shardings
+
+    def _abstract(x, s):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+        return x
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = (
+        NamedSharding(accelerator.mesh, PartitionSpec())
+        if getattr(accelerator, "mesh", None) is not None else None
+    )
+    target = {
+        "params": jax.tree.map(_abstract, state.params, shardings.params),
+        "opt_state": jax.tree.map(_abstract, state.opt_state, shardings.opt_state),
+        "step": jax.ShapeDtypeStruct(state.step.shape, state.step.dtype, sharding=replicated),
+    }
+    if state.extra_state:
+        extra_sh = getattr(shardings, "extra_state", None)
+        target["extra_state"] = (
+            jax.tree.map(_abstract, state.extra_state, extra_sh)
+            if extra_sh is not None
+            else jax.tree.map(lambda x: _abstract(x, None), state.extra_state)
+        )
+    path = os.path.abspath(os.path.join(input_dir, _ORBAX_DIR))
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, target)
+    return state.replace(
+        step=jnp.asarray(restored["step"], jnp.int32),
+        params=restored["params"],
+        opt_state=restored["opt_state"],
+        extra_state=restored.get("extra_state", state.extra_state),
+    )
+
+
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+    pc = accelerator.project_configuration
+    output_dir = _checkpoint_dir(accelerator, output_dir)
+    if pc.automatic_checkpoint_naming and accelerator.is_main_process:
+        base = os.path.dirname(output_dir)
+        os.makedirs(base, exist_ok=True)
+        existing = sorted(
+            (f for f in os.listdir(base) if f.startswith("checkpoint_")),
+            key=lambda f: int(f.split("_")[1]),
+        )
+        # total_limit pruning (reference: accelerator.py:3622-3647).
+        if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
+            import shutil
+
+            for f in existing[: len(existing) + 1 - pc.total_limit]:
+                shutil.rmtree(os.path.join(base, f), ignore_errors=True)
+    accelerator.wait_for_everyone()
+    os.makedirs(output_dir, exist_ok=True)
+
+    state = accelerator._train_state
+    if state is None:
+        raise RuntimeError("Nothing prepared; call accelerator.prepare(...) first.")
+
+    # Model params → name-keyed safetensors (fp32 masters, gathered to host).
+    # fsdp_plugin.state_dict_type picks the file layout (reference:
+    # FULL_STATE_DICT = one file, SHARDED_STATE_DICT = size-split shards +
+    # index, utils/fsdp_utils.py:103-337); both are name-keyed and
+    # reshard-safe, so either loads into any mesh.
+    # DISTRIBUTED_STATE_DICT goes through orbax/TensorStore instead: every
+    # process writes its own shards concurrently and NOTHING gathers to host
+    # rank 0 — the pod-scale path (role of the reference's torch-DCP
+    # sharded-state-dict dirs; restore reshards to whatever mesh is live).
+    plugin = getattr(accelerator, "fsdp_plugin", None)
+    if plugin is not None and plugin.state_dict_type == "DISTRIBUTED_STATE_DICT":
+        _save_distributed_state(accelerator, state, output_dir)
+        _save_host_side_state(accelerator, state, output_dir)
+        if pc.automatic_checkpoint_naming:
+            pc.iteration += 1
+        accelerator.wait_for_everyone()
+        logger.info(
+            f"Saved distributed (orbax) state to {output_dir}", main_process_only=True
+        )
+        return output_dir
+    max_shard = (
+        "5GB" if plugin is None or plugin.state_dict_type == "SHARDED_STATE_DICT" else 10**15
+    )
+    params_host = to_global_host(state.params)
+    if accelerator.is_main_process:
+        save_sharded_safetensors(
+            flatten_state_dict(params_host), output_dir,
+            max_shard_size=max_shard, weights_name=f"{MODEL_NAME}.safetensors",
+        )
+
+    # Optimizer state: flattened name-keyed arrays + treedef-free aux.
+    opt_host = jax.tree.map(
+        lambda x: to_global_host(x) if hasattr(x, 'shape') else x, state.opt_state
+    )
+    step_host = int(np.asarray(state.step))
+    # Non-param collections (flax batch_stats etc.) ride along so BatchNorm
+    # models resume with their running statistics.
+    extra_host = (
+        jax.tree.map(to_global_host, state.extra_state)
+        if state.extra_state else None
+    )
+    if accelerator.is_main_process:
+        with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
+            pickle.dump(
+                {"opt_state": opt_host, "step": step_host, "extra_state": extra_host}, f
+            )
+    _save_host_side_state(accelerator, state, output_dir)
+
     if pc.automatic_checkpoint_naming:
         pc.iteration += 1
     accelerator.wait_for_everyone()
@@ -162,11 +243,37 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     return output_dir
 
 
+def _restore_loss_scale(state, input_dir: str):
+    loss_scale = state.loss_scale
+    scaler_path = os.path.join(input_dir, f"{SCALER_NAME}.bin")
+    if loss_scale is not None and os.path.exists(scaler_path):
+        import jax.numpy as jnp
+
+        with open(scaler_path, "rb") as f:
+            sc = pickle.load(f)
+        loss_scale = loss_scale.replace(
+            scale=jnp.asarray(sc["scale"], jnp.float32),
+            growth_tracker=jnp.asarray(sc["growth_tracker"], jnp.int32),
+        )
+    return loss_scale
+
+
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     input_dir = _checkpoint_dir(accelerator, input_dir, for_load=True)
     state = accelerator._train_state
     if state is None:
         raise RuntimeError("Call accelerator.prepare(...) before load_state().")
+
+    if os.path.isdir(os.path.join(input_dir, _ORBAX_DIR)):
+        new_state = _load_distributed_state(accelerator, state, input_dir)
+        accelerator._train_state = new_state.replace(
+            loss_scale=_restore_loss_scale(state, input_dir)
+        )
+        _load_host_side_state(accelerator, input_dir)
+        logger.info(
+            f"Loaded distributed (orbax) state from {input_dir}", main_process_only=True
+        )
+        return input_dir
 
     flat = load_sharded_safetensors(input_dir, weights_name=f"{MODEL_NAME}.safetensors")
     loaded_tree = unflatten_state_dict(flat)
@@ -192,17 +299,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         opt_payload["opt_state"],
         shardings.opt_state,
     )
-    loss_scale = state.loss_scale
-    scaler_path = os.path.join(input_dir, f"{SCALER_NAME}.bin")
-    if loss_scale is not None and os.path.exists(scaler_path):
-        import jax.numpy as jnp
-
-        with open(scaler_path, "rb") as f:
-            sc = pickle.load(f)
-        loss_scale = loss_scale.replace(
-            scale=jnp.asarray(sc["scale"], jnp.float32),
-            growth_tracker=jnp.asarray(sc["growth_tracker"], jnp.int32),
-        )
+    loss_scale = _restore_loss_scale(state, input_dir)
 
     import jax.numpy as jnp
 
@@ -224,6 +321,13 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         extra_state=extra_state,
     )
 
+    _load_host_side_state(accelerator, input_dir)
+
+    logger.info(f"Loaded accelerator state from {input_dir}", main_process_only=True)
+    return input_dir
+
+
+def _load_host_side_state(accelerator, input_dir: str) -> None:
     for i, scheduler in enumerate(accelerator._schedulers):
         path = os.path.join(input_dir, f"{SCHEDULER_NAME}{'' if i == 0 else f'_{i}'}.bin")
         if os.path.exists(path):
@@ -258,9 +362,6 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     if os.path.exists(rng_path):
         with open(rng_path, "rb") as f:
             load_rng_state(pickle.load(f))
-
-    logger.info(f"Loaded accelerator state from {input_dir}", main_process_only=True)
-    return input_dir
 
 
 def save_custom_state(obj, path: str, index: int = 0):

@@ -1,0 +1,12 @@
+"""Deprecated shim kept for reference-API parity
+(reference: memory_utils.py:18-22 — same warning, same re-export)."""
+
+import warnings
+
+from .utils.memory import *  # noqa: F401,F403
+
+warnings.warn(
+    "memory_utils has moved to accelerate_tpu.utils.memory; this alias will "
+    "be removed in a future release.",
+    FutureWarning,
+)

@@ -58,3 +58,9 @@ from .vit import (
     ViTModel,
     vit_tp_rules,
 )
+from .opt import (
+    OPTConfig,
+    OPTForCausalLM,
+    OPTModel,
+    opt_tp_rules,
+)

@@ -353,6 +353,57 @@ def bert_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# OPT
+# ---------------------------------------------------------------------------
+
+def opt_config_from_hf(hf: Any) -> "OPTConfig":
+    from .opt import OPTConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return OPTConfig(
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        ffn_dim=g("ffn_dim"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        max_position_embeddings=g("max_position_embeddings", 2048),
+    )
+
+
+def opt_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, d = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    pref = "model.decoder." if any(k.startswith("model.decoder.") for k in sd) else "decoder."
+    tree: dict = {"model": {}}
+    _set(tree, "model/embed_tokens/embedding", _np(sd[pref + "embed_tokens.weight"]))
+    _set(tree, "model/embed_positions/embedding", _np(sd[pref + "embed_positions.weight"]))
+    _set(tree, "model/final_layer_norm/scale", _np(sd[pref + "final_layer_norm.weight"]))
+    _set(tree, "model/final_layer_norm/bias", _np(sd[pref + "final_layer_norm.bias"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pref}layers.{i}."
+        layer = {}
+        for name in ("q_proj", "k_proj", "v_proj"):
+            layer[f"self_attn/{name}/kernel"] = _t(sd[p + f"self_attn.{name}.weight"]).reshape(h, nh, d)
+            layer[f"self_attn/{name}/bias"] = _np(sd[p + f"self_attn.{name}.bias"]).reshape(nh, d)
+        layer["self_attn/out_proj/kernel"] = _t(sd[p + "self_attn.out_proj.weight"]).reshape(nh, d, h)
+        layer["self_attn/out_proj/bias"] = _np(sd[p + "self_attn.out_proj.bias"])
+        layer["self_attn_layer_norm/scale"] = _np(sd[p + "self_attn_layer_norm.weight"])
+        layer["self_attn_layer_norm/bias"] = _np(sd[p + "self_attn_layer_norm.bias"])
+        layer["fc1/kernel"] = _t(sd[p + "fc1.weight"])
+        layer["fc1/bias"] = _np(sd[p + "fc1.bias"])
+        layer["fc2/kernel"] = _t(sd[p + "fc2.weight"])
+        layer["fc2/bias"] = _np(sd[p + "fc2.bias"])
+        layer["final_layer_norm/scale"] = _np(sd[p + "final_layer_norm.weight"])
+        layer["final_layer_norm/bias"] = _np(sd[p + "final_layer_norm.bias"])
+        layers.append(layer)
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "model/layers/block", "model/layer_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # ViT
 # ---------------------------------------------------------------------------
 
@@ -511,6 +562,7 @@ _FAMILIES = {
     "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),
     "t5": ("T5ForConditionalGeneration", t5_config_from_hf, t5_params_from_hf),
     "vit": ("ViTForImageClassification", vit_config_from_hf, vit_params_from_hf),
+    "opt": ("OPTForCausalLM", opt_config_from_hf, opt_params_from_hf),
 }
 
 

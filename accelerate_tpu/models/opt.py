@@ -1,0 +1,178 @@
+"""OPT-family decoder, TPU-first.
+
+The reference's big-model-inference benchmarks run exactly this class of
+model (OPT-30B rows in benchmarks/big_model_inference/README.md:25-33);
+owning the family natively means those workloads run here with checkpoint
+interop (models/hub.py) and layer streaming (big_modeling.py).
+
+Architecturally distinct from models/gpt2.py where it matters for checkpoint
+layout: separate q/k/v/out linear projections with biases (not Conv1D fused),
+learned positions with OPT's **offset of 2** (inherited from fairseq's
+pad-token reservation), pre-LN decoder blocks with standard LayerNorm, ReLU
+MLP, tied LM head, and a final LayerNorm before the head
+(``do_layer_norm_before=True`` models — the 350m variant that orders LN
+differently is not replicated here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+
+    # OPT's learned position table is offset by 2 (fairseq legacy).
+    POSITION_OFFSET = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def opt_125m(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def opt_1b3(cls, **kw):
+        return cls(hidden_size=2048, ffn_dim=8192, num_hidden_layers=24,
+                   num_attention_heads=32, **kw)
+
+    @classmethod
+    def opt_6b7(cls, **kw):
+        return cls(hidden_size=4096, ffn_dim=16384, num_hidden_layers=32,
+                   num_attention_heads=32, **kw)
+
+    @classmethod
+    def opt_30b(cls, **kw):
+        return cls(hidden_size=7168, ffn_dim=28672, num_hidden_layers=48,
+                   num_attention_heads=56, **kw)
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = cfg.head_dim
+        dense = partial(
+            nn.DenseGeneral, features=(cfg.num_attention_heads, d),
+            dtype=cfg.dtype, param_dtype=jnp.float32,
+        )
+        # OPT scales the query by 1/sqrt(d) before the dot (same math).
+        q = dense(name="q_proj")(x)
+        k = dense(name="k_proj")(x)
+        v = dense(name="v_proj")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        seq = x.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(causal[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="out_proj",
+        )(out)
+
+
+class OPTBlock(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="self_attn_layer_norm")(x)
+        x = x + OPTAttention(cfg, name="self_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm")(x)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = nn.relu(dense(cfg.ffn_dim, name="fc1")(h))
+        return x + dense(cfg.hidden_size, name="fc2")(h)
+
+
+class _ScannedOPTBlock(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return OPTBlock(self.config, name="block")(x), None
+
+
+class OPTModel(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_tokens")(input_ids)
+        pos = jnp.arange(input_ids.shape[-1]) + cfg.POSITION_OFFSET
+        x = x + nn.Embed(
+            cfg.max_position_embeddings + cfg.POSITION_OFFSET, cfg.hidden_size,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name="embed_positions",
+        )(pos)
+        block_cls = _ScannedOPTBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scanned(cfg, name="layers")(x, None)
+        else:
+            blk = nn.remat(OPTBlock, prevent_cse=False) if cfg.remat else OPTBlock
+            for i in range(cfg.num_hidden_layers):
+                x = blk(cfg, name=f"layer_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm")(x)
+
+
+class OPTForCausalLM(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = OPTModel(cfg, name="model")(input_ids)
+        embedding = self.variables["params"]["model"]["embed_tokens"]["embedding"]
+        return (x @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def opt_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    lead = (None,) if scan_layers else ()
+    return [
+        (r"self_attn/(q_proj|k_proj|v_proj)/kernel", lead + (None, "tp", None)),
+        (r"self_attn/out_proj/kernel", lead + ("tp", None, None)),
+        (r"fc1/kernel", lead + (None, "tp")),
+        (r"fc2/kernel", lead + ("tp", None)),
+        (r"embed_tokens/embedding", ("tp", None)),
+    ]

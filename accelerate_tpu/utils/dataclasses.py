@@ -270,6 +270,9 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     reshard_after_forward: bool = True      # FSDP2 naming (zero3 vs zero2 behavior)
     min_weight_size_to_shard: int = 2**11   # small params stay replicated (auto-wrap min_num_params analog)
     cpu_offload: bool = False               # optimizer state pinned to host memory
+    # FULL_STATE_DICT: one gathered safetensors; SHARDED_STATE_DICT: 5GB-split
+    # safetensors (still gathered to rank 0); DISTRIBUTED_STATE_DICT: orbax/
+    # TensorStore — every process writes its own shards, no gather (pod scale).
     state_dict_type: str = "SHARDED_STATE_DICT"
     activation_checkpointing: bool = False
     mixed_precision_policy: Optional[MixedPrecisionPolicy] = None
