@@ -64,3 +64,9 @@ from .opt import (
     OPTModel,
     opt_tp_rules,
 )
+from .neox import (
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+    GPTNeoXModel,
+    neox_tp_rules,
+)

@@ -353,6 +353,61 @@ def bert_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# GPT-NeoX
+# ---------------------------------------------------------------------------
+
+def neox_config_from_hf(hf: Any) -> "GPTNeoXConfig":
+    from .neox import GPTNeoXConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return GPTNeoXConfig(
+        vocab_size=g("vocab_size"),
+        hidden_size=g("hidden_size"),
+        num_hidden_layers=g("num_hidden_layers"),
+        num_attention_heads=g("num_attention_heads"),
+        intermediate_size=g("intermediate_size"),
+        rotary_pct=g("rotary_pct", 0.25),
+        rotary_emb_base=g("rotary_emb_base", 10000.0),
+        layer_norm_eps=g("layer_norm_eps", 1e-5),
+        use_parallel_residual=bool(g("use_parallel_residual", True)),
+        max_position_embeddings=g("max_position_embeddings", 2048),
+    )
+
+
+def neox_params_from_hf(cfg, sd: dict) -> dict:
+    h, nh, d = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
+    pref = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    tree: dict = {"gpt_neox": {}}
+    _set(tree, "gpt_neox/embed_in/embedding", _np(sd[pref + "embed_in.weight"]))
+    _set(tree, "gpt_neox/final_layer_norm/scale", _np(sd[pref + "final_layer_norm.weight"]))
+    _set(tree, "gpt_neox/final_layer_norm/bias", _np(sd[pref + "final_layer_norm.bias"]))
+    _set(tree, "embed_out/kernel", _t(sd["embed_out.weight"]))
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"{pref}layers.{i}."
+        layers.append({
+            "input_layernorm/scale": _np(sd[p + "input_layernorm.weight"]),
+            "input_layernorm/bias": _np(sd[p + "input_layernorm.bias"]),
+            # (3H, H) with per-head [q|k|v] rows → (H, nh, 3, d).
+            "attention/query_key_value/kernel": _t(sd[p + "attention.query_key_value.weight"]).reshape(h, nh, 3, d),
+            "attention/query_key_value/bias": _np(sd[p + "attention.query_key_value.bias"]).reshape(nh, 3, d),
+            "attention/dense/kernel": _t(sd[p + "attention.dense.weight"]).reshape(nh, d, h),
+            "attention/dense/bias": _np(sd[p + "attention.dense.bias"]),
+            "post_attention_layernorm/scale": _np(sd[p + "post_attention_layernorm.weight"]),
+            "post_attention_layernorm/bias": _np(sd[p + "post_attention_layernorm.bias"]),
+            "dense_h_to_4h/kernel": _t(sd[p + "mlp.dense_h_to_4h.weight"]),
+            "dense_h_to_4h/bias": _np(sd[p + "mlp.dense_h_to_4h.bias"]),
+            "dense_4h_to_h/kernel": _t(sd[p + "mlp.dense_4h_to_h.weight"]),
+            "dense_4h_to_h/bias": _np(sd[p + "mlp.dense_4h_to_h.bias"]),
+        })
+    _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
+                  "gpt_neox/layers/block", "gpt_neox/layer_{i}", cfg.num_hidden_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # OPT
 # ---------------------------------------------------------------------------
 
@@ -563,6 +618,7 @@ _FAMILIES = {
     "t5": ("T5ForConditionalGeneration", t5_config_from_hf, t5_params_from_hf),
     "vit": ("ViTForImageClassification", vit_config_from_hf, vit_params_from_hf),
     "opt": ("OPTForCausalLM", opt_config_from_hf, opt_params_from_hf),
+    "gpt_neox": ("GPTNeoXForCausalLM", neox_config_from_hf, neox_params_from_hf),
 }
 
 

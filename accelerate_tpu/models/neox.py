@@ -1,0 +1,189 @@
+"""GPT-NeoX-family decoder, TPU-first.
+
+The reference's big-model-inference baseline features GPT-NeoX-20B
+(benchmarks/big_model_inference/README.md: 30.9s load / 0.08s per token);
+owning the family natively lets that workload run here with checkpoint
+interop. Architecturally distinct from models/llama.py and models/gpt2.py:
+**parallel residual** (``x + attn(ln1 x) + mlp(ln2 x)`` — one residual add
+for both sublayers), fused per-head [q|k|v] projection, *partial* rotary
+embeddings (``rotary_pct`` of each head's dims rotate, the rest pass
+through), LayerNorm with bias, exact-erf GELU MLP, untied ``embed_out`` head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import apply_rope, rotary_embedding
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    num_hidden_layers: int = 44
+    num_attention_heads: int = 64
+    intermediate_size: int = 24576
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    max_position_embeddings: int = 2048
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def neox_20b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def pythia_1b(cls, **kw):
+        return cls(vocab_size=50304, hidden_size=2048, num_hidden_layers=16,
+                   num_attention_heads=8, intermediate_size=8192, **kw)
+
+
+class GPTNeoXAttention(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        d = cfg.head_dim
+        # Fused per-head [q|k|v] (the query_key_value layout NeoX checkpoints use).
+        qkv = nn.DenseGeneral(
+            features=(cfg.num_attention_heads, 3, d), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="query_key_value",
+        )(x)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        rnd = cfg.rotary_ndims
+        cos, sin = rotary_embedding(positions, rnd, cfg.rotary_emb_base, x.dtype)
+        q = jnp.concatenate([apply_rope(q[..., :rnd], cos, sin), q[..., rnd:]], -1)
+        k = jnp.concatenate([apply_rope(k[..., :rnd], cos, sin), k[..., rnd:]], -1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        seq = x.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(causal[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=x.shape[-1], axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="dense",
+        )(out)
+
+
+class GPTNeoXBlock(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        attn = GPTNeoXAttention(cfg, name="attention")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="input_layernorm")(x), positions
+        )
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+
+        def mlp(h):
+            h = dense(cfg.intermediate_size, name="dense_h_to_4h")(h)
+            h = nn.gelu(h, approximate=False)
+            return dense(cfg.hidden_size, name="dense_4h_to_h")(h)
+
+        if cfg.use_parallel_residual:
+            # One residual for both sublayers — NeoX's signature layout.
+            return x + attn + mlp(
+                nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_attention_layernorm")(x)
+            )
+        x = x + attn
+        return x + mlp(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_attention_layernorm")(x)
+        )
+
+
+class _ScannedGPTNeoXBlock(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        x = GPTNeoXBlock(self.config, name="block")(x, positions)
+        return (x, positions), None
+
+
+class GPTNeoXModel(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_in")(input_ids)
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[-1], dtype=jnp.int32)[None, :], input_ids.shape
+        )
+        block_cls = _ScannedGPTNeoXBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            (x, _), _ = scanned(cfg, name="layers")((x, positions), None)
+        else:
+            blk = nn.remat(GPTNeoXBlock, prevent_cse=False) if cfg.remat else GPTNeoXBlock
+            for i in range(cfg.num_hidden_layers):
+                x = blk(cfg, name=f"layer_{i}")(x, positions)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm")(x)
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = GPTNeoXModel(cfg, name="gpt_neox")(input_ids)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="embed_out",
+        )(x).astype(jnp.float32)
+
+
+def neox_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    lead = (None,) if scan_layers else ()
+    return [
+        (r"attention/query_key_value/kernel", lead + (None, "tp", None, None)),
+        (r"attention/dense/kernel", lead + ("tp", None, None)),
+        (r"dense_h_to_4h/kernel", lead + (None, "tp")),
+        (r"dense_4h_to_h/kernel", lead + ("tp", None)),
+        (r"embed_in/embedding", ("tp", None)),
+        (r"embed_out/kernel", (None, "tp")),
+    ]
