@@ -81,6 +81,11 @@ def _maybe_init_jax_distributed():
     coord = os.environ.get("ACCELERATE_COORDINATOR_ADDRESS")
     if coord is None:
         return
+    # Idempotent across PartialState._reset_state(): the coordinator client
+    # outlives the borg dicts, and re-initializing after the backend is live
+    # is an error.
+    if getattr(jax._src.distributed.global_state, "client", None) is not None:
+        return
     num = int(os.environ.get("ACCELERATE_NUM_PROCESSES", "1"))
     idx = int(os.environ.get("ACCELERATE_PROCESS_INDEX", "0"))
     if coord == "auto":

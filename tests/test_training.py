@@ -190,3 +190,19 @@ def test_sync_semantics_multiprocess():
     # the 8-virtual-device flag pytest's conftest exports.
     out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""})
     assert "TEST_SYNC OK" in out
+
+
+@pytest.mark.slow
+def test_fsdp_facts_multiprocess():
+    """Launched 2-process x 2-virtual-device run of test_fsdp: cross-process
+    mesh, per-process addressable shards, rank-identical loss, ZeRO-2
+    opt-state sharding (reference: tests/test_fsdp.py on live workers)."""
+    import os
+
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(num_processes=2, virtual_devices=2) + [
+        "-m", "accelerate_tpu.test_utils.scripts.test_fsdp"
+    ]
+    out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd()})
+    assert "TEST_FSDP OK" in out
