@@ -1,0 +1,44 @@
+# Suite partitioning mirroring the reference's Makefile:17-75 CI jobs.
+# Everything runs on a virtual 8-device CPU mesh — no TPU needed.
+
+ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+PYTEST = $(ENV) python -m pytest -q
+
+.PHONY: test test_core test_models test_parallel test_big_modeling test_cli \
+        test_examples test_checkpointing test_hub quality bench
+
+test:
+	$(PYTEST) tests/
+
+# Runtime + ops + data + training loop (excludes models/examples/big-model).
+test_core:
+	$(PYTEST) tests/test_state_and_mesh.py tests/test_operations.py \
+	    tests/test_data_loader.py tests/test_training.py tests/test_zero.py \
+	    tests/test_local_sgd.py tests/test_tracking.py tests/test_native.py
+
+test_models:
+	$(PYTEST) tests/test_llama.py tests/test_bert.py tests/test_gpt2.py \
+	    tests/test_t5.py tests/test_moe.py tests/test_opt.py tests/test_neox.py \
+	    tests/test_vit.py tests/test_resnet.py tests/test_generation.py
+
+test_parallel:
+	$(PYTEST) tests/test_pp.py tests/test_attention.py tests/test_inference.py \
+	    tests/test_fp8.py tests/test_quantization.py
+
+test_big_modeling:
+	$(PYTEST) tests/test_big_modeling.py
+
+test_checkpointing:
+	$(PYTEST) tests/test_checkpointing.py
+
+test_cli:
+	$(PYTEST) tests/test_cli.py
+
+test_examples:
+	$(PYTEST) tests/test_examples.py
+
+test_hub:
+	$(PYTEST) tests/test_hub.py
+
+bench:
+	python bench.py

@@ -1,0 +1,39 @@
+"""Feature: KV-cache autoregressive generation (accelerate_tpu.generate) —
+greedy vs sampled continuations from the same tiny model."""
+
+import numpy as np
+
+from _base import make_parser  # noqa: F401  (path setup)
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    args = make_parser().parse_args()
+    from accelerate_tpu import Model, generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(args.seed)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 8), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(args.seed), prompt)
+
+    greedy = generate(model, prompt, max_new_tokens=12)
+    sampled = generate(
+        model, prompt, max_new_tokens=12, temperature=0.8, top_p=0.9,
+        rng=jax.random.key(args.seed),
+    )
+    assert greedy.shape == sampled.shape == (2, 20)
+    # Greedy continuation must equal the argmax of a full re-forward.
+    full = module.apply({"params": model.params}, greedy[:, :-1])
+    nxt = jnp.argmax(full[:, -1].astype(jnp.float32), -1)
+    assert bool((greedy[:, -1] == nxt).all())
+    print(f"greedy tail: {np.asarray(greedy[0, 8:]).tolist()}")
+    print(f"sampled tail: {np.asarray(sampled[0, 8:]).tolist()}")
+    print("generation OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,8 @@ _BY_FEATURE_OK = {
     "quantized_inference.py": "quantized inference OK",
     "tensor_parallel.py": "tp OK",
     "tracking.py": "tracking OK",
+    "generation.py": "generation OK",
+    "pipeline_inference.py": "pipeline inference over",
 }
 
 
@@ -133,6 +135,8 @@ _FEATURE_MARKERS = {
     "tensor_parallel.py": ["tp_rules"],
     "tracking.py": ["init_trackers", "log"],
     "big_model_inference.py": ["dispatch", "device_map"],
+    "generation.py": ["generate"],
+    "pipeline_inference.py": ["prepare_pippy"],
 }
 
 
