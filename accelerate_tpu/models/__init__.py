@@ -70,3 +70,9 @@ from .neox import (
     GPTNeoXModel,
     neox_tp_rules,
 )
+from .whisper import (
+    WhisperConfig,
+    WhisperEncoder,
+    WhisperForConditionalGeneration,
+    whisper_tp_rules,
+)

@@ -353,6 +353,91 @@ def bert_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Whisper
+# ---------------------------------------------------------------------------
+
+def whisper_config_from_hf(hf: Any) -> "WhisperConfig":
+    from .whisper import WhisperConfig
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    return WhisperConfig(
+        vocab_size=g("vocab_size"),
+        num_mel_bins=g("num_mel_bins", 80),
+        d_model=g("d_model"),
+        encoder_layers=g("encoder_layers"),
+        decoder_layers=g("decoder_layers"),
+        encoder_attention_heads=g("encoder_attention_heads"),
+        decoder_attention_heads=g("decoder_attention_heads"),
+        encoder_ffn_dim=g("encoder_ffn_dim"),
+        decoder_ffn_dim=g("decoder_ffn_dim"),
+        max_source_positions=g("max_source_positions", 1500),
+        max_target_positions=g("max_target_positions", 448),
+    )
+
+
+def _whisper_attn(sd, p, dm, nh, d, cross=False) -> dict:
+    out = {
+        "q_proj/kernel": _t(sd[p + "q_proj.weight"]).reshape(dm, nh, d),
+        "q_proj/bias": _np(sd[p + "q_proj.bias"]).reshape(nh, d),
+        "k_proj/kernel": _t(sd[p + "k_proj.weight"]).reshape(dm, nh, d),  # no bias
+        "v_proj/kernel": _t(sd[p + "v_proj.weight"]).reshape(dm, nh, d),
+        "v_proj/bias": _np(sd[p + "v_proj.bias"]).reshape(nh, d),
+        "out_proj/kernel": _t(sd[p + "out_proj.weight"]).reshape(nh, d, dm),
+        "out_proj/bias": _np(sd[p + "out_proj.bias"]),
+    }
+    return out
+
+
+def whisper_params_from_hf(cfg, sd: dict) -> dict:
+    dm, nh, d = cfg.d_model, cfg.encoder_attention_heads, cfg.head_dim
+    pref = "model." if any(k.startswith("model.") for k in sd) else ""
+    tree: dict = {"encoder": {}, "decoder": {}}
+    e = pref + "encoder."
+    # torch Conv1d (out, in, k) → flax (k, in, out).
+    _set(tree, "encoder/conv1/kernel", _np(sd[e + "conv1.weight"]).transpose(2, 1, 0))
+    _set(tree, "encoder/conv1/bias", _np(sd[e + "conv1.bias"]))
+    _set(tree, "encoder/conv2/kernel", _np(sd[e + "conv2.weight"]).transpose(2, 1, 0))
+    _set(tree, "encoder/conv2/bias", _np(sd[e + "conv2.bias"]))
+    _set(tree, "encoder/embed_positions", _np(sd[e + "embed_positions.weight"]))
+    _set(tree, "encoder/layer_norm/scale", _np(sd[e + "layer_norm.weight"]))
+    _set(tree, "encoder/layer_norm/bias", _np(sd[e + "layer_norm.bias"]))
+    d_ = pref + "decoder."
+    _set(tree, "decoder/embed_tokens/embedding", _np(sd[d_ + "embed_tokens.weight"]))
+    _set(tree, "decoder/embed_positions/embedding", _np(sd[d_ + "embed_positions.weight"]))
+    _set(tree, "decoder/layer_norm/scale", _np(sd[d_ + "layer_norm.weight"]))
+    _set(tree, "decoder/layer_norm/bias", _np(sd[d_ + "layer_norm.bias"]))
+
+    def _block(p, cross: bool) -> dict:
+        layer = {}
+        for k, v in _whisper_attn(sd, p + "self_attn.", dm, nh, d).items():
+            layer[f"self_attn/{k}"] = v
+        layer["self_attn_layer_norm/scale"] = _np(sd[p + "self_attn_layer_norm.weight"])
+        layer["self_attn_layer_norm/bias"] = _np(sd[p + "self_attn_layer_norm.bias"])
+        if cross:
+            for k, v in _whisper_attn(sd, p + "encoder_attn.", dm, nh, d).items():
+                layer[f"encoder_attn/{k}"] = v
+            layer["encoder_attn_layer_norm/scale"] = _np(sd[p + "encoder_attn_layer_norm.weight"])
+            layer["encoder_attn_layer_norm/bias"] = _np(sd[p + "encoder_attn_layer_norm.bias"])
+        layer["fc1/kernel"] = _t(sd[p + "fc1.weight"])
+        layer["fc1/bias"] = _np(sd[p + "fc1.bias"])
+        layer["fc2/kernel"] = _t(sd[p + "fc2.weight"])
+        layer["fc2/bias"] = _np(sd[p + "fc2.bias"])
+        layer["final_layer_norm/scale"] = _np(sd[p + "final_layer_norm.weight"])
+        layer["final_layer_norm/bias"] = _np(sd[p + "final_layer_norm.bias"])
+        return layer
+
+    enc_layers = [_block(f"{e}layers.{i}.", False) for i in range(cfg.encoder_layers)]
+    dec_layers = [_block(f"{d_}layers.{i}.", True) for i in range(cfg.decoder_layers)]
+    _place_layers(tree["encoder"], _stack_layers(enc_layers), cfg.scan_layers,
+                  "layers/block", "layer_{i}", cfg.encoder_layers)
+    _place_layers(tree["decoder"], _stack_layers(dec_layers), cfg.scan_layers,
+                  "layers/block", "layer_{i}", cfg.decoder_layers)
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # GPT-NeoX
 # ---------------------------------------------------------------------------
 
@@ -619,6 +704,7 @@ _FAMILIES = {
     "vit": ("ViTForImageClassification", vit_config_from_hf, vit_params_from_hf),
     "opt": ("OPTForCausalLM", opt_config_from_hf, opt_params_from_hf),
     "gpt_neox": ("GPTNeoXForCausalLM", neox_config_from_hf, neox_params_from_hf),
+    "whisper": ("WhisperForConditionalGeneration", whisper_config_from_hf, whisper_params_from_hf),
 }
 
 

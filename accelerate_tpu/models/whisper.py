@@ -1,0 +1,238 @@
+"""Whisper-family speech encoder-decoder, TPU-first.
+
+Audio modality for the native model zoo, with the same design points as the
+text families: MXU-shaped fused per-head projections, optional ``nn.scan``
+over identical blocks, bf16 compute / fp32 params, HF checkpoint interop
+(models/hub.py) with tested logit parity.
+
+Architecture (Whisper convention): the encoder downsamples log-mel features
+with two 1-D convs (stride 1 then 2, GELU between), adds *fixed* sinusoidal
+positions, then runs pre-LN blocks; the decoder uses learned positions,
+causal self-attention plus cross-attention into the encoder states, and a
+head tied to the token embedding. K projections carry no bias (Whisper's
+quirk); all attention scales 1/sqrt(d).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    encoder_attention_heads: int = 6
+    decoder_attention_heads: int = 6
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.encoder_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, num_mel_bins=16, d_model=64, encoder_layers=2,
+            decoder_layers=2, encoder_attention_heads=4, decoder_attention_heads=4,
+            encoder_ffn_dim=128, decoder_ffn_dim=128,
+            max_source_positions=50, max_target_positions=32,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def whisper_tiny(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def whisper_large(cls, **kw):
+        return cls(d_model=1280, encoder_layers=32, decoder_layers=32,
+                   encoder_attention_heads=20, decoder_attention_heads=20,
+                   encoder_ffn_dim=5120, decoder_ffn_dim=5120, **kw)
+
+
+def sinusoidal_positions(length: int, dim: int) -> np.ndarray:
+    """Whisper's fixed sinusoid table (also stored in HF checkpoints —
+    conversion overwrites this init with the checkpoint's copy)."""
+    log_timescale = np.log(10000.0) / (dim // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(dim // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+class WhisperAttention(nn.Module):
+    config: WhisperConfig
+    num_heads: int
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv: Optional[jax.Array] = None):
+        cfg = self.config
+        d = cfg.d_model // self.num_heads
+        kv = x if kv is None else kv
+        dense = partial(
+            nn.DenseGeneral, features=(self.num_heads, d), dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+        )
+        q = dense(name="q_proj")(x)
+        k = dense(name="k_proj", use_bias=False)(kv)  # Whisper: no K bias
+        v = dense(name="v_proj")(kv)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        if self.causal:
+            sq, sk = x.shape[1], kv.shape[1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="out_proj",
+        )(out)
+
+
+class WhisperEncoderBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="self_attn_layer_norm")(x)
+        x = x + WhisperAttention(cfg, cfg.encoder_attention_heads, name="self_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm")(x)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = nn.gelu(dense(cfg.encoder_ffn_dim, name="fc1")(h), approximate=False)
+        return x + dense(cfg.d_model, name="fc2")(h)
+
+
+class WhisperDecoderBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x, enc):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="self_attn_layer_norm")(x)
+        x = x + WhisperAttention(cfg, cfg.decoder_attention_heads, causal=True,
+                                 name="self_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="encoder_attn_layer_norm")(x)
+        x = x + WhisperAttention(cfg, cfg.decoder_attention_heads,
+                                 name="encoder_attn")(h, kv=enc)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_layer_norm")(x)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = nn.gelu(dense(cfg.decoder_ffn_dim, name="fc1")(h), approximate=False)
+        return x + dense(cfg.d_model, name="fc2")(h)
+
+
+class _ScannedEncBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return WhisperEncoderBlock(self.config, name="block")(x), None
+
+
+class _ScannedDecBlock(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, enc = carry
+        x = WhisperDecoderBlock(self.config, name="block")(x, enc)
+        return (x, enc), None
+
+
+def _scan_stack(block_cls, cfg, n, name):
+    return nn.scan(
+        block_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        length=n,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )(cfg, name=name)
+
+
+class WhisperEncoder(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, input_features):
+        """input_features: (B, T, mel) — time-last-channel (NLC, the TPU conv
+        layout; transpose HF's (B, mel, T) on the way in)."""
+        cfg = self.config
+        conv = partial(nn.Conv, features=cfg.d_model, kernel_size=(3,),
+                       padding=1, dtype=cfg.dtype, param_dtype=jnp.float32)
+        x = nn.gelu(conv(name="conv1")(input_features.astype(cfg.dtype)), approximate=False)
+        x = nn.gelu(conv(strides=(2,), name="conv2")(x), approximate=False)
+        pos = self.param(
+            "embed_positions",
+            lambda *_: jnp.asarray(sinusoidal_positions(cfg.max_source_positions, cfg.d_model)),
+        )
+        x = x + pos[None, : x.shape[1]].astype(x.dtype)
+        if cfg.scan_layers:
+            x, _ = _scan_stack(_ScannedEncBlock, cfg, cfg.encoder_layers, "layers")(x, None)
+        else:
+            for i in range(cfg.encoder_layers):
+                x = WhisperEncoderBlock(cfg, name=f"layer_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm")(x)
+
+
+class WhisperDecoder(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, input_ids, enc):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed_tokens")(input_ids)
+        x = x + nn.Embed(cfg.max_target_positions, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed_positions")(
+            jnp.arange(input_ids.shape[-1])
+        )
+        if cfg.scan_layers:
+            (x, _), _ = _scan_stack(_ScannedDecBlock, cfg, cfg.decoder_layers, "layers")(
+                (x, enc), None
+            )
+        else:
+            for i in range(cfg.decoder_layers):
+                x = WhisperDecoderBlock(cfg, name=f"layer_{i}")(x, enc)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm")(x)
+
+
+class WhisperForConditionalGeneration(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, input_features, decoder_input_ids):
+        cfg = self.config
+        enc = WhisperEncoder(cfg, name="encoder")(input_features)
+        dec = WhisperDecoder(cfg, name="decoder")(decoder_input_ids, enc)
+        embedding = self.variables["params"]["decoder"]["embed_tokens"]["embedding"]
+        return (dec @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def whisper_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    lead = (None,) if scan_layers else ()
+    return [
+        (r"(self_attn|encoder_attn)/(q_proj|k_proj|v_proj)/kernel", lead + (None, "tp", None)),
+        (r"(self_attn|encoder_attn)/out_proj/kernel", lead + ("tp", None, None)),
+        (r"fc1/kernel", lead + (None, "tp")),
+        (r"fc2/kernel", lead + ("tp", None)),
+        (r"embed_tokens/embedding", ("tp", None)),
+    ]
