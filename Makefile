@@ -19,7 +19,8 @@ test_core:
 test_models:
 	$(PYTEST) tests/test_llama.py tests/test_bert.py tests/test_gpt2.py \
 	    tests/test_t5.py tests/test_moe.py tests/test_opt.py tests/test_neox.py \
-	    tests/test_vit.py tests/test_resnet.py tests/test_generation.py
+	    tests/test_vit.py tests/test_resnet.py tests/test_whisper.py \
+	    tests/test_generation.py
 
 test_parallel:
 	$(PYTEST) tests/test_pp.py tests/test_attention.py tests/test_inference.py \

@@ -377,7 +377,7 @@ def whisper_config_from_hf(hf: Any) -> "WhisperConfig":
     )
 
 
-def _whisper_attn(sd, p, dm, nh, d, cross=False) -> dict:
+def _whisper_attn(sd, p, dm, nh, d) -> dict:
     out = {
         "q_proj/kernel": _t(sd[p + "q_proj.weight"]).reshape(dm, nh, d),
         "q_proj/bias": _np(sd[p + "q_proj.bias"]).reshape(nh, d),
@@ -391,7 +391,7 @@ def _whisper_attn(sd, p, dm, nh, d, cross=False) -> dict:
 
 
 def whisper_params_from_hf(cfg, sd: dict) -> dict:
-    dm, nh, d = cfg.d_model, cfg.encoder_attention_heads, cfg.head_dim
+    dm = cfg.d_model
     pref = "model." if any(k.startswith("model.") for k in sd) else ""
     tree: dict = {"encoder": {}, "decoder": {}}
     e = pref + "encoder."
@@ -410,6 +410,12 @@ def whisper_params_from_hf(cfg, sd: dict) -> dict:
     _set(tree, "decoder/layer_norm/bias", _np(sd[d_ + "layer_norm.bias"]))
 
     def _block(p, cross: bool) -> dict:
+        # Encoder and decoder stacks may differ in head count; reshape each
+        # with ITS heads (review finding: encoder dims were used for both).
+        nh, d = (
+            (cfg.decoder_attention_heads, cfg.decoder_head_dim)
+            if cross else (cfg.encoder_attention_heads, cfg.head_dim)
+        )
         layer = {}
         for k, v in _whisper_attn(sd, p + "self_attn.", dm, nh, d).items():
             layer[f"self_attn/{k}"] = v

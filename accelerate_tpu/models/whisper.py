@@ -47,6 +47,10 @@ class WhisperConfig:
     def head_dim(self) -> int:
         return self.d_model // self.encoder_attention_heads
 
+    @property
+    def decoder_head_dim(self) -> int:
+        return self.d_model // self.decoder_attention_heads
+
     @classmethod
     def tiny(cls, **kw):
         defaults = dict(
@@ -159,6 +163,8 @@ class _ScannedDecBlock(nn.Module):
 
 
 def _scan_stack(block_cls, cfg, n, name):
+    if cfg.remat:
+        block_cls = nn.remat(block_cls, prevent_cse=False)
     return nn.scan(
         block_cls,
         variable_axes={"params": 0},
@@ -188,8 +194,9 @@ class WhisperEncoder(nn.Module):
         if cfg.scan_layers:
             x, _ = _scan_stack(_ScannedEncBlock, cfg, cfg.encoder_layers, "layers")(x, None)
         else:
+            blk = nn.remat(WhisperEncoderBlock, prevent_cse=False) if cfg.remat else WhisperEncoderBlock
             for i in range(cfg.encoder_layers):
-                x = WhisperEncoderBlock(cfg, name=f"layer_{i}")(x)
+                x = blk(cfg, name=f"layer_{i}")(x)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm")(x)
 
 
@@ -210,8 +217,9 @@ class WhisperDecoder(nn.Module):
                 (x, enc), None
             )
         else:
+            blk = nn.remat(WhisperDecoderBlock, prevent_cse=False) if cfg.remat else WhisperDecoderBlock
             for i in range(cfg.decoder_layers):
-                x = WhisperDecoderBlock(cfg, name=f"layer_{i}")(x, enc)
+                x = blk(cfg, name=f"layer_{i}")(x, enc)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm")(x)
 
 

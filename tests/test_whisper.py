@@ -80,3 +80,71 @@ def test_whisper_hf_logit_parity():
     ours = model_from_pretrained(hf, dtype=jnp.float32)
     got = np.asarray(ours(jnp.asarray(feats.transpose(0, 2, 1)), jnp.asarray(dec.astype(np.int32))))
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_whisper_hf_parity_asymmetric_heads_and_unscanned():
+    """decoder_attention_heads != encoder_attention_heads must reshape with
+    each stack's OWN head count (review finding), and the unscanned
+    (layer_{i}) layout must load too."""
+    import dataclasses
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from accelerate_tpu.models import load_pretrained
+    from accelerate_tpu.models.hub import whisper_params_from_hf
+
+    hf_cfg = transformers.WhisperConfig(
+        vocab_size=128, num_mel_bins=16, d_model=64, encoder_layers=2,
+        decoder_layers=2, encoder_attention_heads=4, decoder_attention_heads=2,
+        encoder_ffn_dim=128, decoder_ffn_dim=128,
+        max_source_positions=24, max_target_positions=32,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2, decoder_start_token_id=1,
+        suppress_tokens=None, begin_suppress_tokens=None,
+    )
+    torch.manual_seed(1)
+    hf = transformers.WhisperForConditionalGeneration(hf_cfg)
+    hf.eval()
+    rng = np.random.default_rng(1)
+    feats = rng.normal(size=(1, 16, 48)).astype(np.float32)
+    dec = rng.integers(0, 128, (1, 5)).astype(np.int64)
+    with torch.no_grad():
+        want = hf(
+            input_features=torch.from_numpy(feats),
+            decoder_input_ids=torch.from_numpy(dec),
+        ).logits.numpy()
+
+    cfg, params, cls = load_pretrained(hf, dtype=jnp.float32)
+    got = np.asarray(Model(module=cls(cfg), params=params)(
+        jnp.asarray(feats.transpose(0, 2, 1)), jnp.asarray(dec.astype(np.int32))
+    ))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+    # Unscanned layout: same checkpoint, layer_{i} names.
+    un_cfg = dataclasses.replace(cfg, scan_layers=False)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    un_params = whisper_params_from_hf(un_cfg, sd)
+    got2 = np.asarray(Model(module=cls(un_cfg), params=un_params)(
+        jnp.asarray(feats.transpose(0, 2, 1)), jnp.asarray(dec.astype(np.int32))
+    ))
+    np.testing.assert_allclose(got2, want, rtol=3e-4, atol=3e-4)
+
+
+def test_whisper_remat_flag_changes_nothing_numerically():
+    set_seed(0)
+    cfg = WhisperConfig.tiny(dtype=jnp.float32)
+    module = WhisperForConditionalGeneration(cfg)
+    feats, dec = _inputs(cfg)
+    params = module.init(jax.random.key(0), feats, dec)["params"]
+    base = module.apply({"params": params}, feats, dec)
+
+    import dataclasses
+
+    rcfg = dataclasses.replace(cfg, remat=True)
+    rmodule = WhisperForConditionalGeneration(rcfg)
+    import numpy as _np
+
+    _np.testing.assert_allclose(
+        _np.asarray(rmodule.apply({"params": params}, feats, dec)),
+        _np.asarray(base), rtol=1e-6,
+    )
