@@ -51,6 +51,7 @@ from .big_modeling import (  # noqa: E402
     disk_offload,
     dispatch_model,
     init_empty_weights,
+    init_on_device,
     load_checkpoint_and_dispatch,
 )
 from .inference import (  # noqa: E402

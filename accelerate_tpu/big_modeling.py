@@ -45,6 +45,7 @@ from .utils.other import flatten_state_dict, unflatten_state_dict
 
 __all__ = [
     "init_empty_weights",
+    "init_on_device",
     "cpu_offload",
     "cpu_offload_with_hook",
     "disk_offload",
@@ -64,6 +65,14 @@ def init_empty_weights(module, *sample_args, rng=None, **sample_kwargs):
     describing ``module.init``'s params.
     """
     return compute_abstract_params(module, *sample_args, rng=rng, **sample_kwargs)
+
+
+def init_on_device(device):
+    """Context manager placing array creation (``module.init`` included) on
+    ``device`` — host RAM via ``jax.local_devices(backend="cpu")[0]`` for
+    models that must not touch HBM during init (reference:
+    big_modeling.py:116-178 ``init_on_device``)."""
+    return jax.default_device(device)
 
 
 # ---------------------------------------------------------------------------

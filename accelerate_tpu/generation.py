@@ -230,10 +230,55 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache):
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
+def _opt_forward_cached(cfg, params, input_ids, cache: KVCache):
+    """OPT decode with the same cache contract (learned positions with the
+    fairseq offset of 2, pre-LN ReLU blocks — mirrors models/opt.py)."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    model_p = params["model"]
+    stacked = model_p["layers"]["block"]
+    embed = model_p["embed_tokens"]["embedding"]
+
+    b, s = input_ids.shape
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions_b = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
+    x = x + jnp.take(
+        model_p["embed_positions"]["embedding"], positions[0] + cfg.POSITION_OFFSET, axis=0
+    ).astype(cfg.dtype)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv = layer
+        attn = p["self_attn"]
+        hn = _layer_norm(h, p["self_attn_layer_norm"], cfg.layer_norm_eps)
+        q = _proj(hn, attn["q_proj"]["kernel"]) + attn["q_proj"]["bias"].astype(hn.dtype)
+        k_new = _proj(hn, attn["k_proj"]["kernel"]) + attn["k_proj"]["bias"].astype(hn.dtype)
+        v_new = _proj(hn, attn["v_proj"]["kernel"]) + attn["v_proj"]["bias"].astype(hn.dtype)
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions_b)
+        h = h + _out_proj(out, attn["out_proj"]["kernel"]) + attn["out_proj"]["bias"].astype(h.dtype)
+        hn = _layer_norm(h, p["final_layer_norm"], cfg.layer_norm_eps)
+        mid = jax.nn.relu(
+            hn @ p["fc1"]["kernel"].astype(hn.dtype) + p["fc1"]["bias"].astype(hn.dtype)
+        )
+        h = h + mid @ p["fc2"]["kernel"].astype(mid.dtype) + p["fc2"]["bias"].astype(mid.dtype)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
+    x = _layer_norm(x, model_p["final_layer_norm"], cfg.layer_norm_eps)
+    logits = x[:, -1] @ embed.T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
 # module class name -> forward_cached(cfg, params, ids, cache)
 GENERATION_PLANS: dict[str, Callable] = {
     "LlamaForCausalLM": _llama_forward_cached,
     "GPT2LMHeadModel": _gpt2_forward_cached,
+    "OPTForCausalLM": _opt_forward_cached,
 }
 
 

@@ -234,3 +234,22 @@ def test_cpu_offload_with_hook_chaining():
     assert device_of(m2h) == host
     # Second pass still works and matches.
     np.testing.assert_allclose(np.asarray(m2h(m1h(x))), np.asarray(y), rtol=1e-6)
+
+
+def test_init_on_device_places_params_on_host():
+    import flax.linen as nn
+    import jax
+
+    from accelerate_tpu import init_on_device
+
+    host = jax.local_devices(backend="cpu")[0]
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    with init_on_device(host):
+        params = M().init(jax.random.key(0), jax.numpy.ones((1, 4)))["params"]
+    leaf = jax.tree.leaves(params)[0]
+    assert next(iter(leaf.devices())) == host

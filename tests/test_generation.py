@@ -178,3 +178,20 @@ def test_generation_config_and_pad_token(llama):
     )
     row = np.asarray(got[0, ids.shape[1]:])
     assert row[0] == eos and (row[1:] == 9).all()
+
+
+def test_opt_greedy_generate_matches_naive_loop():
+    from accelerate_tpu.models import OPTConfig, OPTForCausalLM
+
+    set_seed(3)
+    cfg = OPTConfig.tiny(dtype=jnp.float32)
+    module = OPTForCausalLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 6), dtype=np.int32))
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    got = generate(model, ids, max_new_tokens=5)
+    out = ids
+    for _ in range(5):
+        logits = module.apply({"params": model.params}, out)
+        tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+        out = jnp.concatenate([out, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
