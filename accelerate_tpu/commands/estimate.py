@@ -77,6 +77,24 @@ def _params_from_builtin(spec: str):
 
         cfg = MixtralConfig.tiny() if size == "tiny" else MixtralConfig(**json.loads(size))
         module = MixtralForCausalLM(cfg)
+    elif family == "opt":
+        from ..models import OPTConfig, OPTForCausalLM
+
+        ctor = {"125m": OPTConfig.opt_125m, "1b3": OPTConfig.opt_1b3,
+                "6b7": OPTConfig.opt_6b7, "30b": OPTConfig.opt_30b,
+                "tiny": OPTConfig.tiny}
+        module = OPTForCausalLM(ctor[size]())
+    elif family in ("neox", "gpt_neox"):
+        from ..models import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        ctor = {"20b": GPTNeoXConfig.neox_20b, "pythia-1b": GPTNeoXConfig.pythia_1b,
+                "tiny": GPTNeoXConfig.tiny}
+        module = GPTNeoXForCausalLM(ctor[size]())
+    elif family == "gpt2":
+        from ..models import GPT2Config, GPT2LMHeadModel
+
+        ctor = {"base": GPT2Config.gpt2, "xl": GPT2Config.gpt2_xl, "tiny": GPT2Config.tiny}
+        module = GPT2LMHeadModel(ctor[size]())
     else:
         raise KeyError(family)
     ids = np.zeros((1, 8), dtype=np.int32)

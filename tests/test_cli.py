@@ -336,3 +336,11 @@ def test_convert_config_fsdp2_and_unknown_subkeys():
     assert cfg.fsdp_sharding_strategy == "SHARD_GRAD_OP"
     joined = "\n".join(notes)
     assert "fsdp_mystery_knob" in joined  # unknown sub-keys reported
+
+
+def test_estimate_memory_new_builtin_families(capsys):
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    for spec in ("opt:tiny", "neox:tiny", "gpt2:tiny"):
+        assert main(["estimate-memory", spec]) == 0
+        assert "Memory estimate" in capsys.readouterr().out
