@@ -274,11 +274,135 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache):
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
+def _neox_forward_cached(cfg, params, input_ids, cache: KVCache):
+    """GPT-NeoX decode: parallel residual, fused per-head [q|k|v], partial
+    rotary — mirrors models/neox.py."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    gp = params["gpt_neox"]
+    stacked = gp["layers"]["block"]
+
+    b, s = input_ids.shape
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions_b = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(gp["embed_in"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    rnd = cfg.rotary_ndims
+    cos, sin = rotary_embedding(positions_b, rnd, cfg.rotary_emb_base, x.dtype)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv = layer
+        attn = p["attention"]
+        hn = _layer_norm(h, p["input_layernorm"], cfg.layer_norm_eps)
+        qkv = jnp.einsum(
+            "bsh,hncd->bsncd", hn, attn["query_key_value"]["kernel"].astype(hn.dtype)
+        ) + attn["query_key_value"]["bias"].astype(hn.dtype)
+        q, k_new, v_new = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        q = jnp.concatenate([apply_rope(q[..., :rnd], cos, sin), q[..., rnd:]], -1)
+        k_new = jnp.concatenate([apply_rope(k_new[..., :rnd], cos, sin), k_new[..., rnd:]], -1)
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions_b)
+        attn_out = (
+            jnp.einsum("bsnd,ndh->bsh", out, attn["dense"]["kernel"].astype(out.dtype))
+            + attn["dense"]["bias"].astype(out.dtype)
+        )
+
+        def mlp(inp):
+            hn2 = _layer_norm(inp, p["post_attention_layernorm"], cfg.layer_norm_eps)
+            mid = jax.nn.gelu(
+                hn2 @ p["dense_h_to_4h"]["kernel"].astype(hn2.dtype)
+                + p["dense_h_to_4h"]["bias"].astype(hn2.dtype),
+                approximate=False,
+            )
+            return (
+                mid @ p["dense_4h_to_h"]["kernel"].astype(mid.dtype)
+                + p["dense_4h_to_h"]["bias"].astype(mid.dtype)
+            )
+
+        if cfg.use_parallel_residual:
+            # One residual for both sublayers; the MLP sees pre-attention h.
+            h = h + attn_out + mlp(h)
+        else:
+            h = h + attn_out
+            h = h + mlp(h)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
+    x = _layer_norm(x, gp["final_layer_norm"], cfg.layer_norm_eps)
+    logits = x[:, -1] @ params["embed_out"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
+def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache):
+    """Mixtral decode: Llama attention + routed sparse-MLP on raw params
+    (mirrors models/moe.py — dropless here since decode batches are tiny)."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    model_p = params["model"]
+    stacked = model_p["layers"]["block"]
+    embed = model_p["embed_tokens"]["embedding"]
+
+    b, s = input_ids.shape
+    start = cache.length
+    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
+    k = cfg.num_experts_per_tok
+
+    def moe(p, h):
+        T = b * s
+        tokens = h.reshape(T, -1)
+        router_logits = tokens.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)  # (T, k)
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+        # Dense dispatch over experts: fine at decode sizes, exact (dropless).
+        def per_expert(e):
+            gate = jax.nn.silu(tokens @ p["w_gate"][e].astype(tokens.dtype))
+            up = tokens @ p["w_up"][e].astype(tokens.dtype)
+            return (gate * up) @ p["w_down"][e].astype(tokens.dtype)
+
+        expert_out = jax.vmap(per_expert)(jnp.arange(cfg.num_local_experts))  # (E, T, H)
+        picked = jnp.take_along_axis(
+            jnp.transpose(expert_out, (1, 0, 2)), topi[..., None], axis=1
+        )  # (T, k, H)
+        out = jnp.sum(picked * topv[..., None].astype(picked.dtype), axis=1)
+        return out.reshape(b, s, -1)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv = layer
+        attn = p["self_attn"]
+        hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        q = apply_rope(_proj(hn, attn["q_proj"]["kernel"]), cos, sin)
+        k_new = apply_rope(_proj(hn, attn["k_proj"]["kernel"]), cos, sin)
+        v_new = _proj(hn, attn["v_proj"]["kernel"])
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions)
+        h = h + _out_proj(out, attn["o_proj"]["kernel"])
+        hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        h = h + moe(p["moe"], hn)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
+    x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), cfg.rms_norm_eps)
+    logits = x[:, -1] @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
 # module class name -> forward_cached(cfg, params, ids, cache)
 GENERATION_PLANS: dict[str, Callable] = {
     "LlamaForCausalLM": _llama_forward_cached,
     "GPT2LMHeadModel": _gpt2_forward_cached,
     "OPTForCausalLM": _opt_forward_cached,
+    "GPTNeoXForCausalLM": _neox_forward_cached,
+    "MixtralForCausalLM": _mixtral_forward_cached,
 }
 
 

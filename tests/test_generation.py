@@ -195,3 +195,44 @@ def test_opt_greedy_generate_matches_naive_loop():
         tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
         out = jnp.concatenate([out, tok[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
+
+
+def test_neox_greedy_generate_matches_naive_loop():
+    from accelerate_tpu.models import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    for parallel in (True, False):
+        set_seed(4)
+        cfg = GPTNeoXConfig.tiny(dtype=jnp.float32, use_parallel_residual=parallel)
+        module = GPTNeoXForCausalLM(cfg)
+        ids = jnp.asarray(np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 6), dtype=np.int32))
+        model = Model.from_flax(module, jax.random.key(0), ids)
+        got = generate(model, ids, max_new_tokens=4)
+        out = ids
+        for _ in range(4):
+            logits = module.apply({"params": model.params}, out)
+            tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+            out = jnp.concatenate([out, tok[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
+
+
+def test_mixtral_greedy_generate_matches_naive_loop():
+    import dataclasses
+
+    from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+
+    set_seed(5)
+    # High capacity so the GShard training path is dropless too — then the
+    # dense decode dispatch and the training forward agree exactly.
+    cfg = dataclasses.replace(
+        MixtralConfig.tiny(dtype=jnp.float32), capacity_factor=8.0
+    )
+    module = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, cfg.vocab_size, (1, 5), dtype=np.int32))
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    got = generate(model, ids, max_new_tokens=4)
+    out = ids
+    for _ in range(4):
+        logits = module.apply({"params": model.params}, out)
+        tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+        out = jnp.concatenate([out, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
