@@ -63,6 +63,7 @@ from .inference import (  # noqa: E402
 from .generation import (  # noqa: E402
     GenerationConfig,
     KVCache,
+    beam_search,
     generate,
     init_cache,
     register_generation_plan,

@@ -492,3 +492,105 @@ def generate(
         step, (cache, logits, rng, done0), None, length=max_new_tokens
     )
     return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)
+
+
+def beam_search(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    *,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    forward_cached: Optional[Callable] = None,
+) -> jax.Array:
+    """Beam-search decoding over the same KV-cache plans as :func:`generate`.
+
+    Standard length-normalized beam search (score = logprob_sum /
+    len^length_penalty): the prompt prefills once per batch row, the cache is
+    tiled to ``B×num_beams``, and every step selects the global top-K of
+    ``K×V`` candidates, reordering the cache along the beam axis. Beams that
+    emit ``eos_token_id`` freeze (their score stops accumulating; the eos is
+    kept, later slots pad with it). Returns the single best sequence per
+    batch row, shape (B, S + max_new_tokens).
+    """
+    cfg = model.module.config
+    params = model.params
+    fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
+    if fwd is None:
+        known = ", ".join(sorted(GENERATION_PLANS))
+        raise ValueError(
+            f"No generation plan for {type(model.module).__name__!r}; built-in: {known}"
+        )
+    input_ids = jnp.asarray(input_ids)
+    b, s = input_ids.shape
+    k = num_beams
+    t_max = s + max_new_tokens
+    max_pos = _cache_dims(cfg)[3]
+    if t_max > max_pos:
+        raise ValueError(f"{t_max} tokens exceeds max_position_embeddings={max_pos}")
+
+    cache = init_cache(cfg, b, t_max)
+    logits, cache = jax.jit(partial(fwd, cfg))(params, input_ids, cache)
+    logp = jax.nn.log_softmax(logits, axis=-1)  # (B, V)
+    v = logp.shape[-1]
+
+    # Tile the cache across beams: (L, B, ...) → (L, B*K, ...).
+    def tile(x):
+        return jnp.repeat(x, k, axis=1)
+
+    cache = KVCache(tile(cache.k), tile(cache.v), cache.length)
+    # Beam 0 carries the prompt's logp; others start dead so the first step
+    # picks K distinct tokens from beam 0's distribution.
+    scores = jnp.full((b, k), -jnp.inf).at[:, 0].set(0.0)
+    first = jnp.broadcast_to(logp[:, None, :], (b, k, v))
+    done = jnp.zeros((b, k), bool)
+    lengths = jnp.zeros((b, k), jnp.int32)
+    tokens = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+
+    decode = jax.jit(partial(fwd, cfg))
+    neg_inf = jnp.asarray(-jnp.inf)
+
+    cand_logp = first
+    for t in range(max_new_tokens):
+        # Candidate scores (B, K, V); frozen beams may only "continue" via
+        # their 0th slot at unchanged score (one candidate, not V).
+        cand = scores[..., None] + jnp.where(done[..., None], 0.0, cand_logp)
+        frozen_mask = jnp.arange(v)[None, None, :] != 0
+        cand = jnp.where(done[..., None] & frozen_mask, neg_inf, cand)
+        flat = cand.reshape(b, k * v)
+        top_scores, top_idx = jax.lax.top_k(flat, k)  # (B, K)
+        beam_idx = top_idx // v
+        tok = (top_idx % v).astype(jnp.int32)
+
+        # Reorder everything along the beam axis.
+        gather = lambda a: jnp.take_along_axis(a, beam_idx, axis=1)
+        was_done = gather(done)
+        lengths = gather(lengths)
+        prev_tokens = jnp.take_along_axis(
+            tokens, beam_idx[..., None], axis=1
+        )
+        eos = eos_token_id if eos_token_id is not None else -1
+        emit = jnp.where(was_done, eos if eos_token_id is not None else 0, tok)
+        tokens = prev_tokens.at[:, :, t].set(emit)
+        lengths = jnp.where(was_done, lengths, lengths + 1)
+        scores = top_scores
+        done = was_done | (
+            (emit == eos) if eos_token_id is not None else jnp.zeros_like(was_done)
+        )
+
+        flat_beam = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)
+        cache = KVCache(
+            jnp.take(cache.k, flat_beam, axis=1),
+            jnp.take(cache.v, flat_beam, axis=1),
+            cache.length,
+        )
+        if t + 1 < max_new_tokens:
+            logits, cache = decode(params, emit.reshape(b * k, 1), cache)
+            cand_logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, k, v)
+
+    final = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+    best = jnp.argmax(final, axis=1)  # (B,)
+    best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]
+    prompt = jnp.broadcast_to(input_ids[:, None, :], (b, 1, s))[:, 0]
+    return jnp.concatenate([prompt, best_tokens.astype(input_ids.dtype)], axis=1)

@@ -236,3 +236,55 @@ def test_mixtral_greedy_generate_matches_naive_loop():
         tok = jnp.argmax(logits[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
         out = jnp.concatenate([out, tok[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(out))
+
+
+def test_beam_search_beam1_equals_greedy(llama):
+    from accelerate_tpu import beam_search
+
+    cfg, module, model, ids = llama
+    greedy = generate(model, ids, max_new_tokens=5)
+    beamed = beam_search(model, ids, max_new_tokens=5, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beamed), np.asarray(greedy))
+
+
+def test_beam_search_finds_exhaustive_optimum():
+    """vocab=16, 2 new tokens, num_beams=16: the beam covers every first
+    token, so the result must be the global-logprob argmax (computed by brute
+    force over all 256 continuations)."""
+    from accelerate_tpu import beam_search
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    set_seed(7)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native", vocab_size=16)
+    module = LlamaForCausalLM(cfg)
+    ids = jnp.asarray([[3, 1, 4]], jnp.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+
+    got = beam_search(model, ids, max_new_tokens=2, num_beams=16, length_penalty=1.0)
+
+    # Brute force: score every (a, b) continuation by summed logprob.
+    best_score, best_pair = -np.inf, None
+    logits0 = module.apply({"params": model.params}, ids)
+    lp0 = np.asarray(jax.nn.log_softmax(logits0[:, -1].astype(jnp.float32), -1))[0]
+    for a in range(16):
+        ext = jnp.concatenate([ids, jnp.asarray([[a]], jnp.int32)], 1)
+        logits1 = module.apply({"params": model.params}, ext)
+        lp1 = np.asarray(jax.nn.log_softmax(logits1[:, -1].astype(jnp.float32), -1))[0]
+        for bb in range(16):
+            sc = lp0[a] + lp1[bb]
+            if sc > best_score:
+                best_score, best_pair = sc, (a, bb)
+    assert tuple(np.asarray(got[0, 3:]).tolist()) == best_pair
+
+
+def test_beam_search_eos_freezes_and_pads(llama):
+    from accelerate_tpu import beam_search
+
+    cfg, module, model, ids = llama
+    first = generate(model, ids, max_new_tokens=1)[:, -1]
+    eos = int(first[0])
+    out = beam_search(model, ids, max_new_tokens=4, num_beams=3, eos_token_id=eos)
+    assert out.shape == (2, ids.shape[1] + 4)
+    row = np.asarray(out[0, ids.shape[1]:])
+    if row[0] == eos:
+        assert (row == eos).all()
