@@ -65,6 +65,7 @@ from .generation import (  # noqa: E402
     KVCache,
     beam_search,
     generate,
+    speculative_generate,
     init_cache,
     register_generation_plan,
     sample_logits,

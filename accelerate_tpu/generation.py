@@ -494,6 +494,106 @@ def generate(
     return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)
 
 
+def speculative_generate(
+    model,
+    draft_model,
+    input_ids,
+    max_new_tokens: int = 32,
+    *,
+    num_draft_tokens: int = 4,
+    eos_token_id: Optional[int] = None,
+) -> jax.Array:
+    """Greedy speculative decoding: a small draft model proposes
+    ``num_draft_tokens`` greedily, ONE target forward scores all proposals at
+    once, and the longest prefix whose target-argmax agrees is accepted plus
+    one corrected token. Output is EXACTLY the target model's greedy
+    continuation — the draft only changes how many target forwards it takes
+    (best case ``ceil(N / (k+1))`` instead of ``N``).
+
+    Both models share the KV-cache plan registry; the target cache is
+    re-synced to the accepted prefix by re-running the accepted tokens (cache
+    writes are position-indexed, so overwriting rejected slots is free).
+    """
+    cfg = model.module.config
+    dcfg = draft_model.module.config
+    fwd = GENERATION_PLANS.get(type(model.module).__name__)
+    dfwd = GENERATION_PLANS.get(type(draft_model.module).__name__)
+    if fwd is None or dfwd is None:
+        raise ValueError("Both models need generation plans (see GENERATION_PLANS)")
+    input_ids = jnp.asarray(input_ids)
+    b, s = input_ids.shape
+    if b != 1:
+        raise ValueError("speculative_generate supports batch size 1")
+    t_max = s + max_new_tokens + num_draft_tokens + 1
+    if t_max > min(_cache_dims(cfg)[3], _cache_dims(dcfg)[3]):
+        raise ValueError("sequence would exceed max positions")
+
+    # Scoring needs per-position logits, not just the last token's: run the
+    # plain (uncached) apply over prefix+proposals. Each distinct length
+    # compiles once; pad to length buckets if that matters for your workload.
+    target_apply = jax.jit(lambda p, ids: model.apply_fn({"params": p}, ids))
+    draft_step = jax.jit(partial(dfwd, dcfg))
+
+    out = input_ids
+    dcache = init_cache(dcfg, b, t_max)
+    # Prefill draft on the prompt.
+    dlogits, dcache = draft_step(draft_model.params, out, dcache)
+
+    produced = 0
+    while produced < max_new_tokens:
+        k = min(num_draft_tokens, max_new_tokens - produced)
+        # Draft proposes k tokens greedily (cached, one token at a time).
+        proposals = []
+        dl = dlogits
+        dc = dcache
+        for _ in range(k):
+            tok = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            proposals.append(tok)
+            dl, dc = draft_step(draft_model.params, tok[:, None], dc)
+        prop = jnp.stack(proposals, axis=1)  # (1, k)
+
+        # One target forward over prefix + proposals scores every position.
+        scored = target_apply(model.params, jnp.concatenate([out, prop], axis=1))
+        # target argmax at position len(out)-1 predicts the 1st new token, etc.
+        pred = jnp.argmax(
+            scored[:, out.shape[1] - 1: out.shape[1] + k - 1].astype(jnp.float32), -1
+        ).astype(jnp.int32)  # (1, k) — what the target would emit at each slot
+        agree = np.asarray(pred[0] == prop[0])
+        n_accept = int(np.argmin(agree)) if not agree.all() else k
+        # Accepted prefix + the target's own token at the first disagreement
+        # (or the bonus token after k agreements).
+        correction = jnp.argmax(
+            scored[:, out.shape[1] + n_accept - 1].astype(jnp.float32), -1
+        ).astype(jnp.int32)
+        new_toks = jnp.concatenate(
+            [prop[:, :n_accept], correction[:, None]], axis=1
+        )[:, : max_new_tokens - produced]
+        out = jnp.concatenate([out, new_toks], axis=1)
+        produced += new_toks.shape[1]
+        if eos_token_id is not None and bool((new_toks == eos_token_id).any()):
+            # Trim after the first EOS and pad.
+            arr = np.array(out[0, s:])  # writable copy
+            idx = int(np.argmax(arr == eos_token_id))
+            arr[idx + 1:] = eos_token_id
+            out = jnp.concatenate(
+                [input_ids, jnp.asarray(arr)[None].astype(input_ids.dtype)], axis=1
+            )
+            break
+        # Re-sync the draft cache: accepted tokens == proposals for the first
+        # n_accept positions (their cached K/V is already right); rewind the
+        # length to before the correction token and feed it, overwriting the
+        # one stale slot.
+        dcache = KVCache(dc.k, dc.v, jnp.asarray(out.shape[1] - 1, jnp.int32))
+        dlogits, dcache = draft_step(draft_model.params, out[:, -1:], dcache)
+
+    # Pad to the full length if EOS ended the loop early.
+    if out.shape[1] < s + max_new_tokens:
+        pad_id = eos_token_id if eos_token_id is not None else 0
+        pad = jnp.full((1, s + max_new_tokens - out.shape[1]), pad_id, out.dtype)
+        out = jnp.concatenate([out, pad], axis=1)
+    return out[:, : s + max_new_tokens]
+
+
 def beam_search(
     model,
     input_ids,

@@ -288,3 +288,38 @@ def test_beam_search_eos_freezes_and_pads(llama):
     row = np.asarray(out[0, ids.shape[1]:])
     if row[0] == eos:
         assert (row == eos).all()
+
+
+def test_speculative_generate_exactly_matches_greedy(llama):
+    """Draft-accelerated decoding must reproduce the target's greedy output
+    bit-for-bit, whatever the draft proposes."""
+    from accelerate_tpu import speculative_generate
+
+    cfg, module, model, ids = llama
+    prompt = ids[:1]
+    want = generate(model, prompt, max_new_tokens=10)
+
+    # Draft 1: the target itself (all proposals accepted — fastest path).
+    got_self = speculative_generate(model, model, prompt, max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(got_self), np.asarray(want))
+
+    # Draft 2: a DIFFERENT tiny model (frequent rejections).
+    set_seed(99)
+    other = Model.from_flax(
+        type(module)(cfg), jax.random.key(99), np.asarray(prompt)
+    )
+    got_other = speculative_generate(model, other, prompt, max_new_tokens=10,
+                                     num_draft_tokens=3)
+    np.testing.assert_array_equal(np.asarray(got_other), np.asarray(want))
+
+
+def test_speculative_generate_eos(llama):
+    from accelerate_tpu import speculative_generate
+
+    cfg, module, model, ids = llama
+    prompt = ids[:1]
+    eos = int(generate(model, prompt, max_new_tokens=1)[0, -1])
+    out = speculative_generate(model, model, prompt, max_new_tokens=6, eos_token_id=eos)
+    row = np.asarray(out[0, prompt.shape[1]:])
+    assert out.shape == (1, prompt.shape[1] + 6)
+    assert row[0] == eos and (row == eos).all()
