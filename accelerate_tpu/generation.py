@@ -103,9 +103,10 @@ def _attend(q, k, v, q_positions):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _llama_forward_cached(cfg, params, input_ids, cache: KVCache):
+def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
     """Run ``input_ids`` (appended at cache.length) through all layers,
-    returning (logits_for_last_token, new_cache)."""
+    returning (logits, new_cache) — last-token logits, or every position's
+    with ``return_all`` (speculative verification needs them)."""
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     model_p = params["model"] if "model" in params else params
@@ -139,11 +140,11 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache):
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
     x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), cfg.rms_norm_eps)
-    last = x[:, -1]
+    h_out = x if return_all else x[:, -1]
     if cfg.tie_word_embeddings:
-        logits = last @ embed.T.astype(cfg.dtype)
+        logits = h_out @ embed.T.astype(cfg.dtype)
     else:
-        logits = last @ params["lm_head"]["kernel"].astype(cfg.dtype)
+        logits = h_out @ params["lm_head"]["kernel"].astype(cfg.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
@@ -184,7 +185,7 @@ def _layer_norm(x, p, eps):
     return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
-def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache):
+def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
     """GPT-2 decode with the same cache contract (learned positions, fused
     c_attn, GELU MLP — mirrors models/gpt2.py)."""
     if not cfg.scan_layers:
@@ -226,11 +227,11 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache):
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
     x = _layer_norm(x, tr["ln_f"], cfg.layer_norm_epsilon)
-    logits = x[:, -1] @ wte.T.astype(cfg.dtype)
+    logits = (x if return_all else x[:, -1]) @ wte.T.astype(cfg.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _opt_forward_cached(cfg, params, input_ids, cache: KVCache):
+def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
     """OPT decode with the same cache contract (learned positions with the
     fairseq offset of 2, pre-LN ReLU blocks — mirrors models/opt.py)."""
     if not cfg.scan_layers:
@@ -270,11 +271,11 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache):
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
     x = _layer_norm(x, model_p["final_layer_norm"], cfg.layer_norm_eps)
-    logits = x[:, -1] @ embed.T.astype(cfg.dtype)
+    logits = (x if return_all else x[:, -1]) @ embed.T.astype(cfg.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _neox_forward_cached(cfg, params, input_ids, cache: KVCache):
+def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
     """GPT-NeoX decode: parallel residual, fused per-head [q|k|v], partial
     rotary — mirrors models/neox.py."""
     if not cfg.scan_layers:
@@ -332,11 +333,11 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache):
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
     x = _layer_norm(x, gp["final_layer_norm"], cfg.layer_norm_eps)
-    logits = x[:, -1] @ params["embed_out"]["kernel"].astype(cfg.dtype)
+    logits = (x if return_all else x[:, -1]) @ params["embed_out"]["kernel"].astype(cfg.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache):
+def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
     """Mixtral decode: Llama attention + routed sparse-MLP on raw params
     (mirrors models/moe.py — dropless here since decode batches are tiny)."""
     if not cfg.scan_layers:
@@ -392,7 +393,7 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache):
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
     x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), cfg.rms_norm_eps)
-    logits = x[:, -1] @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    logits = (x if return_all else x[:, -1]) @ params["lm_head"]["kernel"].astype(cfg.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
@@ -503,17 +504,22 @@ def speculative_generate(
     num_draft_tokens: int = 4,
     eos_token_id: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy speculative decoding: a small draft model proposes
-    ``num_draft_tokens`` greedily, ONE target forward scores all proposals at
-    once, and the longest prefix whose target-argmax agrees is accepted plus
-    one corrected token. Output is EXACTLY the target model's greedy
-    continuation — the draft only changes how many target forwards it takes
-    (best case ``ceil(N / (k+1))`` instead of ``N``).
+    """Greedy speculative decoding: the draft proposes ``num_draft_tokens``
+    greedily through its KV cache; ONE cached target pass over the proposal
+    window (``return_all=True``) scores every slot; the longest agreeing
+    prefix is accepted plus the target's correction token. The result is the
+    target's greedy continuation (bit-identical to :func:`generate` in fp32;
+    low-precision configs can differ where the top-2 logits sit within the
+    window-shape numerics) — the draft only changes how many target passes it
+    takes: best case ``ceil(N / (k+1))`` windows of k tokens instead of N
+    single-token steps.
 
-    Both models share the KV-cache plan registry; the target cache is
-    re-synced to the accepted prefix by re-running the accepted tokens (cache
-    writes are position-indexed, so overwriting rejected slots is free).
+    Both caches are position-indexed, so after a rejection each cache just
+    rewinds its length to the accepted prefix and the next write overwrites
+    the stale slots. Batch size 1.
     """
+    if num_draft_tokens < 1:
+        raise ValueError(f"num_draft_tokens must be >= 1, got {num_draft_tokens}")
     cfg = model.module.config
     dcfg = draft_model.module.config
     fwd = GENERATION_PLANS.get(type(model.module).__name__)
@@ -528,50 +534,44 @@ def speculative_generate(
     if t_max > min(_cache_dims(cfg)[3], _cache_dims(dcfg)[3]):
         raise ValueError("sequence would exceed max positions")
 
-    # Scoring needs per-position logits, not just the last token's: run the
-    # plain (uncached) apply over prefix+proposals. Each distinct length
-    # compiles once; pad to length buckets if that matters for your workload.
-    target_apply = jax.jit(lambda p, ids: model.apply_fn({"params": p}, ids))
+    target_step = jax.jit(partial(fwd, cfg), static_argnames=("return_all",))
     draft_step = jax.jit(partial(dfwd, dcfg))
 
     out = input_ids
+    tcache = init_cache(cfg, b, t_max)
     dcache = init_cache(dcfg, b, t_max)
-    # Prefill draft on the prompt.
+    # Prefill both caches on the prompt; carry the target's next-token logits.
+    tlogits, tcache = target_step(model.params, out, tcache)
     dlogits, dcache = draft_step(draft_model.params, out, dcache)
 
     produced = 0
     while produced < max_new_tokens:
-        k = min(num_draft_tokens, max_new_tokens - produced)
+        k = num_draft_tokens
         # Draft proposes k tokens greedily (cached, one token at a time).
         proposals = []
-        dl = dlogits
-        dc = dcache
+        dl, dc = dlogits, dcache
         for _ in range(k):
             tok = jnp.argmax(dl, axis=-1).astype(jnp.int32)
             proposals.append(tok)
             dl, dc = draft_step(draft_model.params, tok[:, None], dc)
         prop = jnp.stack(proposals, axis=1)  # (1, k)
 
-        # One target forward over prefix + proposals scores every position.
-        scored = target_apply(model.params, jnp.concatenate([out, prop], axis=1))
-        # target argmax at position len(out)-1 predicts the 1st new token, etc.
-        pred = jnp.argmax(
-            scored[:, out.shape[1] - 1: out.shape[1] + k - 1].astype(jnp.float32), -1
-        ).astype(jnp.int32)  # (1, k) — what the target would emit at each slot
-        agree = np.asarray(pred[0] == prop[0])
+        # One cached target pass over the k-token window; position j's logits
+        # predict the token AFTER proposal j. Combined with the carried
+        # ``tlogits`` (the prediction for slot 0) every slot is scored.
+        win_logits, tc = target_step(model.params, prop, tcache, return_all=True)
+        preds = jnp.concatenate([tlogits[:, None], win_logits], axis=1)  # (1, k+1, V)
+        pred_tok = jnp.argmax(preds.astype(jnp.float32), axis=-1).astype(jnp.int32)
+        agree = np.asarray(pred_tok[0, :k] == prop[0])
         n_accept = int(np.argmin(agree)) if not agree.all() else k
-        # Accepted prefix + the target's own token at the first disagreement
-        # (or the bonus token after k agreements).
-        correction = jnp.argmax(
-            scored[:, out.shape[1] + n_accept - 1].astype(jnp.float32), -1
-        ).astype(jnp.int32)
+        # Accepted proposals + the target's own token at the divergence (or
+        # the bonus token after k agreements).
         new_toks = jnp.concatenate(
-            [prop[:, :n_accept], correction[:, None]], axis=1
+            [prop[:, :n_accept], pred_tok[:, n_accept:n_accept + 1]], axis=1
         )[:, : max_new_tokens - produced]
         out = jnp.concatenate([out, new_toks], axis=1)
         produced += new_toks.shape[1]
         if eos_token_id is not None and bool((new_toks == eos_token_id).any()):
-            # Trim after the first EOS and pad.
             arr = np.array(out[0, s:])  # writable copy
             idx = int(np.argmax(arr == eos_token_id))
             arr[idx + 1:] = eos_token_id
@@ -579,12 +579,19 @@ def speculative_generate(
                 [input_ids, jnp.asarray(arr)[None].astype(input_ids.dtype)], axis=1
             )
             break
-        # Re-sync the draft cache: accepted tokens == proposals for the first
-        # n_accept positions (their cached K/V is already right); rewind the
-        # length to before the correction token and feed it, overwriting the
-        # one stale slot.
-        dcache = KVCache(dc.k, dc.v, jnp.asarray(out.shape[1] - 1, jnp.int32))
-        dlogits, dcache = draft_step(draft_model.params, out[:, -1:], dcache)
+        if produced >= max_new_tokens:
+            break
+        # Rewind both caches to the accepted prefix minus the last token and
+        # re-feed it: its K/V slot rewrites (the only stale one — accepted
+        # proposals' slots already hold the right K/V) and the carried logits
+        # refresh.
+        rewind = jnp.asarray(out.shape[1] - 1, jnp.int32)
+        tlogits, tcache = target_step(
+            model.params, out[:, -1:], KVCache(tc.k, tc.v, rewind)
+        )
+        dlogits, dcache = draft_step(
+            draft_model.params, out[:, -1:], KVCache(dc.k, dc.v, rewind)
+        )
 
     # Pad to the full length if EOS ended the loop early.
     if out.shape[1] < s + max_new_tokens:
