@@ -127,9 +127,16 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
         attn = p["self_attn"]
         hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
-        q = apply_rope(_proj(hn, attn["q_proj"]["kernel"]), cos, sin)
-        k_new = apply_rope(_proj(hn, attn["k_proj"]["kernel"]), cos, sin)
-        v_new = _proj(hn, attn["v_proj"]["kernel"])
+
+        def qkv(name):
+            y = _proj(hn, attn[name]["kernel"])
+            if "bias" in attn[name]:  # Qwen2-style attention_bias checkpoints
+                y = y + attn[name]["bias"].astype(y.dtype)
+            return y
+
+        q = apply_rope(qkv("q_proj"), cos, sin)
+        k_new = apply_rope(qkv("k_proj"), cos, sin)
+        v_new = qkv("v_proj")
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions)

@@ -117,6 +117,10 @@ def llama_config_from_hf(hf: Any) -> "LlamaConfig":
         rms_norm_eps=g("rms_norm_eps", 1e-5),
         rope_theta=g("rope_theta", 10000.0),
         tie_word_embeddings=bool(g("tie_word_embeddings", False)),
+        # Qwen2 always carries q/k/v biases; Llama/Mistral expose the flag.
+        attention_bias=bool(
+            g("attention_bias", g("model_type") == "qwen2")
+        ),
     )
 
 
@@ -140,6 +144,11 @@ def llama_params_from_hf(cfg, sd: dict) -> dict:
             "mlp/down_proj/kernel": _t(sd[p + "mlp.down_proj.weight"]),
             "input_layernorm/weight": _np(sd[p + "input_layernorm.weight"]),
             "post_attention_layernorm/weight": _np(sd[p + "post_attention_layernorm.weight"]),
+            **({
+                "self_attn/q_proj/bias": _np(sd[p + "self_attn.q_proj.bias"]).reshape(nh, d),
+                "self_attn/k_proj/bias": _np(sd[p + "self_attn.k_proj.bias"]).reshape(nkv, d),
+                "self_attn/v_proj/bias": _np(sd[p + "self_attn.v_proj.bias"]).reshape(nkv, d),
+            } if cfg.attention_bias else {}),
         })
     _place_layers(tree, _stack_layers(layers), cfg.scan_layers,
                   "model/layers/block", "model/layers_{i}", cfg.num_hidden_layers)
@@ -703,6 +712,7 @@ def t5_params_from_hf(cfg, sd: dict) -> dict:
 _FAMILIES = {
     "llama": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "mistral": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
+    "qwen2": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "mixtral": ("MixtralForCausalLM", mixtral_config_from_hf, mixtral_params_from_hf),
     "gpt2": ("GPT2LMHeadModel", gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),

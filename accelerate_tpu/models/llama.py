@@ -46,6 +46,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    attention_bias: bool = False        # Qwen2-style checkpoints: bias on q/k/v
     dtype: Any = jnp.bfloat16          # compute dtype (params stay fp32 masters)
     scan_layers: bool = True
     remat: bool = False
@@ -177,7 +178,8 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         d = cfg.head_dim
         dense = partial(
-            nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            nn.DenseGeneral, use_bias=cfg.attention_bias, dtype=cfg.dtype,
+            param_dtype=jnp.float32,
             **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
         )
         q = dense(features=(cfg.num_attention_heads, d), name="q_proj")(x)

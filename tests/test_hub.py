@@ -158,3 +158,60 @@ def test_load_pretrained_from_directory(tmp_path, rng):
 def test_unknown_family_raises():
     with pytest.raises(ValueError, match="Unsupported model family"):
         load_pretrained(({"model_type": "umbrellanet"}, {}))
+
+
+def test_mistral_logit_parity(rng):
+    """model_type 'mistral' routes through the Llama family (GQA, no sliding
+    window at these lengths)."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg)
+    ids = _ids(rng, 128, (2, 10))
+    ours = _convert(hf)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_qwen2_logit_parity_attention_bias(rng):
+    """Qwen2 = Llama architecture + q/k/v biases: conversion must carry them."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg)
+    cfg, params, cls = __import__("accelerate_tpu.models", fromlist=["load_pretrained"]).load_pretrained(
+        hf, dtype=jnp.float32
+    )
+    assert cfg.attention_bias, "Qwen2 conversion must enable attention_bias"
+    assert "bias" in params["model"]["layers"]["block"]["self_attn"]["q_proj"]
+    ids = _ids(rng, 128, (2, 10))
+    got = np.asarray(Model(module=cls(cfg), params=params)(ids))
+    np.testing.assert_allclose(got, _logits(hf, ids), rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_generates_like_transformers(rng):
+    from accelerate_tpu import generate
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg)
+    hf.eval()
+    ids = rng.integers(0, 96, (1, 6)).astype(np.int64)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, do_sample=False, pad_token_id=0
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(ours, ids.astype(np.int32), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
