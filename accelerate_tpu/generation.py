@@ -81,7 +81,11 @@ def _out_proj(x, kernel):
 def _mlp(cfg, p, x):
     gate = x @ p["gate_proj"]["kernel"].astype(x.dtype)
     up = x @ p["up_proj"]["kernel"].astype(x.dtype)
-    return (jax.nn.silu(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
+    act = (
+        jax.nn.silu if getattr(cfg, "hidden_act", "silu") == "silu"
+        else partial(jax.nn.gelu, approximate=True)
+    )
+    return (act(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
 
 
 def _attend(q, k, v, q_positions):
@@ -120,13 +124,19 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     positions = jnp.broadcast_to(positions, (b, s))
 
     x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
+    if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
+        x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
+    plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
+
+    def norm_w(w, like):
+        return (w + plus1).astype(like.dtype) if plus1 else w.astype(like.dtype)
 
     def one_layer(carry, layer):
         h = carry
         p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
         attn = p["self_attn"]
-        hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        hn = rms_norm(h, norm_w(p["input_layernorm"]["weight"], h), cfg.rms_norm_eps)
 
         def qkv(name):
             y = _proj(hn, attn[name]["kernel"])
@@ -141,12 +151,12 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions)
         h = h + _out_proj(out, attn["o_proj"]["kernel"])
-        hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
+        hn = rms_norm(h, norm_w(p["post_attention_layernorm"]["weight"], h), cfg.rms_norm_eps)
         h = h + _mlp(cfg, p["mlp"], hn)
         return h, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
-    x = rms_norm(x, model_p["norm"]["weight"].astype(x.dtype), cfg.rms_norm_eps)
+    x = rms_norm(x, norm_w(model_p["norm"]["weight"], x), cfg.rms_norm_eps)
     h_out = x if return_all else x[:, -1]
     if cfg.tie_word_embeddings:
         logits = h_out @ embed.T.astype(cfg.dtype)

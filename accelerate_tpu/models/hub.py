@@ -185,6 +185,25 @@ def llama_params_to_hf(cfg, params) -> dict:
     return {k: np.asarray(v) for k, v in sd.items()}
 
 
+def gemma_config_from_hf(hf: Any) -> "LlamaConfig":
+    """Gemma rides the Llama family with three quirks: GeGLU MLP, RMSNorm
+    scales stored as (weight + 1), embeddings scaled by sqrt(hidden)."""
+    import dataclasses as _dc
+
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    cfg = llama_config_from_hf(hf)
+    return _dc.replace(
+        cfg,
+        head_dim=g("head_dim", 256),
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_plus_one=True,
+        scale_embeddings=True,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Mixtral (Llama attention + sparse MoE MLP)
 # ---------------------------------------------------------------------------
@@ -713,6 +732,7 @@ _FAMILIES = {
     "llama": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "mistral": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "qwen2": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
+    "gemma": ("LlamaForCausalLM", gemma_config_from_hf, llama_params_from_hf),
     "mixtral": ("MixtralForCausalLM", mixtral_config_from_hf, mixtral_params_from_hf),
     "gpt2": ("GPT2LMHeadModel", gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),

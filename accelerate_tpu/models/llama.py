@@ -47,6 +47,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     attention_bias: bool = False        # Qwen2-style checkpoints: bias on q/k/v
+    # Gemma-family quirks (all default off → plain Llama):
+    hidden_act: str = "silu"            # "gelu_tanh" for Gemma's GeGLU
+    rms_norm_plus_one: bool = False     # norm scale stored as (weight + 1)
+    scale_embeddings: bool = False      # multiply embeddings by sqrt(hidden)
     dtype: Any = jnp.bfloat16          # compute dtype (params stay fp32 masters)
     scan_layers: bool = True
     remat: bool = False
@@ -106,10 +110,14 @@ def rms_norm(x, weight, eps):
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    plus_one: bool = False  # Gemma stores scale as (weight + 1), init zeros
 
     @nn.compact
     def __call__(self, x):
-        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        init = nn.initializers.zeros if self.plus_one else nn.initializers.ones
+        weight = self.param("weight", init, (x.shape[-1],), jnp.float32)
+        if self.plus_one:
+            weight = weight + 1.0
         return rms_norm(x, weight.astype(x.dtype), self.eps)
 
 
@@ -209,7 +217,8 @@ class LlamaMLP(nn.Module):
         )
         gate = dense(cfg.intermediate_size, name="gate_proj")(x)
         up = dense(cfg.intermediate_size, name="up_proj")(x)
-        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+        act = nn.silu if cfg.hidden_act == "silu" else partial(nn.gelu, approximate=True)
+        return dense(cfg.hidden_size, name="down_proj")(act(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -219,10 +228,11 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x), positions
+            RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="input_layernorm")(x),
+            positions,
         )
         out = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, name="post_attention_layernorm")(h)
+            RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="post_attention_layernorm")(h)
         )
         return out
 
@@ -249,6 +259,8 @@ class LlamaModel(nn.Module):
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
             name="embed_tokens",
         )(input_ids)
+        if cfg.scale_embeddings:  # Gemma normalizer
+            x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
         positions = jnp.arange(input_ids.shape[-1])[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, input_ids.shape)
         # Selective remat: with the flash kernel the attention residuals
@@ -288,7 +300,7 @@ class LlamaModel(nn.Module):
                 if cfg.remat:
                     blk = nn.remat(blk, **remat_kwargs)
                 x = blk(cfg, name=f"layers_{i}")(x, positions)
-        return RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        return RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="norm")(x)
 
 
 class LlamaForCausalLM(nn.Module):

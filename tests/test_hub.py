@@ -215,3 +215,42 @@ def test_qwen2_generates_like_transformers(rng):
     ours = model_from_pretrained(hf, dtype=jnp.float32)
     got = generate(ours, ids.astype(np.int32), max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
+
+
+def test_gemma_logit_parity(rng):
+    """Gemma quirks: GeGLU, RMSNorm(1+w), sqrt(hidden)-scaled embeddings,
+    head_dim decoupled from hidden/heads, tied head."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(hf_cfg)
+    ids = _ids(rng, 128, (2, 10))
+    ours = _convert(hf)
+    assert ours.module.config.rms_norm_plus_one and ours.module.config.scale_embeddings
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=3e-4, atol=3e-4
+    )
+
+
+def test_gemma_generates_like_transformers(rng):
+    from accelerate_tpu import generate
+
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(2)
+    hf = transformers.GemmaForCausalLM(hf_cfg)
+    hf.eval()
+    ids = rng.integers(1, 96, (1, 6)).astype(np.int64)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, do_sample=False, pad_token_id=0
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(ours, ids.astype(np.int32), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
