@@ -1,60 +1,133 @@
 """Benchmark: Llama training throughput on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+structured extras ("mfu_2048", "tok_s_8192", "mfu_8192", "params_b",
+"device_kind", and "error" on failure) so the driver's parse never depends on
+prose inside the unit string.
 
-Measures tokens/sec/chip for an FSDP-prepared Llama decoder train step in bf16
-(the BASELINE.json headline: FSDP2 Llama tokens/sec/chip, target ≥45% MFU).
-``vs_baseline`` reports achieved_MFU / 0.45 — ≥1.0 means the MFU target is met.
+Architecture (hard-won across rounds):
 
-Timing notes (hard-won): the axon remote runtime's ``block_until_ready`` does
-not actually block, and the first post-warmup step pays a second compile
-(donated-buffer layout), so the loop warms up twice and the barrier is a host
-fetch of the final loss — which transitively waits on every chained step.
+- **Supervisor/child split.** Round 2's evidence was erased by one transient
+  ``UNAVAILABLE: TPU backend setup/compile error`` at ``jax.devices()`` —
+  and JAX caches a failed backend for the life of the process, so in-process
+  retry is useless. ``python bench.py`` therefore supervises: it launches
+  itself with ``--child`` in a subprocess, retries retryable failures
+  (UNAVAILABLE / init / DEADLINE / hangs) with bounded backoff, steps down a
+  config ladder on RESOURCE_EXHAUSTED, and after the final failure emits a
+  parseable error JSON instead of a traceback.
+
+- **Model scale.** BASELINE.md frames the target as 7B-class FSDP training;
+  334M (rounds 1-2) is too small to predict that regime. The child benches a
+  ~1.06B-param Llama (hidden 2048, inter 5632, 18 layers) at seq 2048 AND
+  8192. On a 16GB chip (v5e) the 1B + Adam working set only fits with bf16
+  params + bf16 optimizer moments (the PaLM-style TPU recipe); with >=30GB
+  HBM the child keeps fp32 masters. The choice is recorded in the unit
+  string.
+
+- **Timing.** The axon remote runtime's ``block_until_ready`` does not
+  actually block, and the first post-warmup step pays a second compile
+  (donated-buffer layout), so the loop warms up twice and the barrier is a
+  host fetch of the final loss — which transitively waits on every chained
+  step.
 
 Attention runs the Pallas flash kernel (ops/pallas_flash.py) under the
-"dots" remat policy (keep every matmul output + the kernel's O(S) residuals,
-recompute only elementwise ops) at batch 4 — the winner of
-benchmarks/ablate.py's policy x batch sweep: 51.5k tok/s/chip vs 46.8k for
-the flash-only policy at batch 8, vs 24.7k for naive attention under plain
-remat (same 334M model, seq 2048).
+"dots" remat policy at seq 2048 (keep matmul outputs, recompute elementwise —
+the winner of benchmarks/ablate.py's sweep) and the leaner "flash" policy at
+seq 8192 where dots residuals no longer fit.
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+METRIC = "llama_fsdp_train_tokens_per_sec_per_chip"
+MFU_TARGET = 0.45  # BASELINE.md contract: >=45% MFU
 
-def _pick_config(platform: str, seq: int):
+# Substrings (case-insensitive) in stderr that mean "try again, the backend
+# may come back" — exactly the failure class that erased round 2's numbers.
+RETRYABLE = (
+    "unavailable",
+    "unable to initialize backend",
+    "backend setup/compile error",
+    "deadline_exceeded",
+    "aborted",
+    "connection reset",
+    "socket closed",
+    "failed to connect",
+)
+
+# bf16 peak FLOP/s per chip by device_kind substring (lowercase).
+PEAK_FLOPS = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e / "v5 lite"
+    ("v4", 275e12),
+    ("v3", 61.25e12),  # per core
+    ("v2", 22.5e12),
+]
+
+
+def _peak_flops(device_kind: str) -> float:
+    kind = (device_kind or "").lower()
+    for sub, flops in PEAK_FLOPS:
+        if sub in kind:
+            return flops
+    return 197e12  # unknown TPU: assume v5e-class
+
+
+def _hbm_bytes() -> int:
+    """Per-device HBM limit; conservative 16GB when the backend won't say."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0)) if stats else 0
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return 16 * 1024**3
+
+
+def _build_config(seq: int, oom_level: int, big_hbm: bool):
+    """~1.06B-param Llama. The OOM ladder shrinks batch/remat, never the
+    model — the >=1B scale is the point of the bench."""
     import jax.numpy as jnp
 
     from accelerate_tpu.models import LlamaConfig
 
-    if platform in ("tpu", "axon"):
-        # ~334M params: fits one v5e chip (16GB HBM) with Adam fp32 states.
-        return (
-            LlamaConfig(
-                vocab_size=32000,
-                hidden_size=1024,
-                intermediate_size=4096,
-                num_hidden_layers=16,
-                num_attention_heads=8,
-                num_key_value_heads=8,
-                max_position_embeddings=seq,
-                dtype=jnp.bfloat16,
-                remat=True,
-                remat_policy="dots",
-                attention_impl="flash",
-            ),
-            # benchmarks/ablate.py sweep: "dots" wants the smaller batch
-            # (more VMEM headroom per step beats batch-level parallelism).
-            4 if seq <= 2048 else 1,  # batch
-        )
-    return LlamaConfig.tiny(dtype=jnp.bfloat16), 4
+    if seq <= 2048:
+        batch = 2 if oom_level == 0 else 1
+        policy = "dots" if oom_level < 2 else "flash"
+    else:
+        batch = 1
+        policy = "flash" if oom_level < 2 else "minimal"
+    if big_hbm and oom_level == 0:
+        batch *= 2
+    cfg = LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_hidden_layers=18,
+        num_attention_heads=16,
+        num_key_value_heads=16,
+        max_position_embeddings=seq,
+        dtype=jnp.bfloat16,
+        remat=True,
+        remat_policy=policy,
+        attention_impl="flash",
+    )
+    return cfg, batch
 
 
-def _measure(platform: str, seq: int, iters: int):
+def _measure(seq: int, iters: int, oom_level: int, on_chip: bool):
     import jax
+    import jax.numpy as jnp
     import optax
 
     from accelerate_tpu import Accelerator, Model
@@ -66,16 +139,31 @@ def _measure(platform: str, seq: int, iters: int):
     GradientState._reset_state()
     PartialState._reset_state()
     set_seed(0)
-    cfg, batch = _pick_config(platform, seq)
-    if platform not in ("tpu", "axon"):
-        seq = 128
+
+    hbm = _hbm_bytes()
+    big_hbm = hbm >= 30 * 1024**3
+    if on_chip:
+        cfg, batch = _build_config(seq, oom_level, big_hbm)
+    else:
+        from accelerate_tpu.models import LlamaConfig
+
+        cfg, batch, seq = LlamaConfig.tiny(dtype=jnp.bfloat16), 4, 128
+
     module = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1), dtype=np.int32)
 
     acc = Accelerator(mixed_precision="bf16", fsdp_plugin=FullyShardedDataParallelPlugin())
     model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
-    model, _ = acc.prepare(model, optax.adamw(3e-4, weight_decay=0.1))
+    # 16GB chips cannot hold 1B fp32 masters + fp32 Adam moments + grads;
+    # use the bf16-everything TPU recipe there and fp32 masters when HBM allows.
+    precision = "fp32-masters" if big_hbm else "bf16-params+opt"
+    if on_chip and not big_hbm:
+        model.params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), model.params)
+        tx = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+    else:
+        tx = optax.adamw(3e-4, weight_decay=0.1)
+    model, _ = acc.prepare(model, tx)
     n_params = model.num_parameters()
 
     def loss_fn(params, b):
@@ -104,41 +192,166 @@ def _measure(platform: str, seq: int, iters: int):
     dt = (time.perf_counter() - t0) / iters
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
-    n_devices = len(jax.devices())
+    devices = jax.devices()
+    n_devices = len(devices)
+    kind = getattr(devices[0], "device_kind", "") or devices[0].platform
     tok_s_chip = batch * seq / dt / n_devices
     # MFU: ~6*N FLOPs/token for fwd+bwd + attention term 12*L*H*S per token.
     attn_flops_per_token = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     flops_per_token = 6 * n_params + attn_flops_per_token
-    peak_flops = {"tpu": 197e12, "axon": 197e12}.get(platform, 1e12)  # v5e bf16
-    mfu = tok_s_chip * flops_per_token / peak_flops
-    return tok_s_chip, mfu, n_params
+    peak = _peak_flops(kind) if on_chip else 1e12
+    mfu = tok_s_chip * flops_per_token / peak
+    return {
+        "tok_s": tok_s_chip,
+        "seq": seq,
+        "mfu": mfu,
+        "n_params": n_params,
+        "batch": batch,
+        "device_kind": kind,
+        "precision": precision,
+        "remat_policy": cfg.remat_policy,
+    }
 
 
-def main():
+def child(oom_level: int) -> int:
     import jax
+
+    # The axon site-hook calls jax.config.update("jax_platforms", "axon,cpu")
+    # at interpreter start, which outranks the JAX_PLATFORMS env var — so an
+    # explicit env request (e.g. local CPU smoke runs) must be re-asserted
+    # through the same config knob.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
 
     platform = jax.devices()[0].platform
     on_chip = platform in ("tpu", "axon")
-    tok, mfu, n_params = _measure(platform, 2048, 30 if on_chip else 3)
+    r2k = _measure(2048, 30 if on_chip else 3, oom_level, on_chip)
+
+    result = {
+        "metric": METRIC,
+        "value": round(r2k["tok_s"], 1),
+        "vs_baseline": round(r2k["mfu"] / MFU_TARGET, 3),
+        "mfu_2048": round(r2k["mfu"], 4),
+        "params_b": round(r2k["n_params"] / 1e9, 3),
+        "device_kind": r2k["device_kind"],
+        "platform": platform,
+    }
     extra = ""
     if on_chip:
-        tok8k, mfu8k, _ = _measure(platform, 8192, 15)
-        extra = f"; seq-8192: {tok8k:.0f} tok/s/chip MFU {mfu8k:.3f}"
+        # seq-8192 phase: a failure here must not erase the seq-2048 result,
+        # so handle it internally and report partial data only as a last
+        # resort — OOM steps the config ladder, transient backend errors
+        # retry in place (the supervisor can't help without discarding the
+        # 2048 numbers).
+        err8k = None
+        lvl, transient_left = oom_level, 3
+        while lvl < 3:
+            try:
+                r8k = _measure(8192, 15, lvl, on_chip)
+                result["tok_s_8192"] = round(r8k["tok_s"], 1)
+                result["mfu_8192"] = round(r8k["mfu"], 4)
+                extra = f"; seq-8192: {r8k['tok_s']:.0f} tok/s/chip MFU {r8k['mfu']:.3f}"
+                err8k = None
+                break
+            except Exception as e:  # noqa: BLE001 - recorded, not swallowed
+                err8k = f"{type(e).__name__}: {e}"
+                msg = str(e).lower()
+                if "resource_exhausted" in msg:
+                    lvl += 1
+                elif any(pat in msg for pat in RETRYABLE) and transient_left > 0:
+                    transient_left -= 1
+                    time.sleep(30)
+                else:
+                    break
+        if err8k is not None:
+            result["seq8192_error"] = err8k[:500]
 
+    result["unit"] = (
+        f"tokens/s/chip (bf16 compute, {r2k['precision']}, "
+        f"{r2k['n_params'] / 1e9:.2f}B params, seq {r2k['seq']} batch {r2k['batch']}, "
+        f"flash+{r2k['remat_policy']}-remat, MFU {r2k['mfu']:.3f}{extra})"
+    )
+    print(json.dumps(result))
+    return 0
+
+
+def _parse_last_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and obj.get("metric") == METRIC:
+                    return obj
+            except ValueError:
+                continue
+    return None
+
+
+def supervise() -> int:
+    """Run the child with retries so one transient backend failure can never
+    again erase a round's perf evidence (round-2 postmortem)."""
+    deadline = time.monotonic() + 75 * 60
+    oom_level = 0
+    last_err = ""
+    attempt = 0
+    max_attempts = 8
+    while attempt < max_attempts:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining < 120:
+            last_err = last_err or "supervisor wall-clock budget exhausted"
+            break
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", f"--oom-level={oom_level}"]
+        try:
+            proc = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                timeout=min(remaining, 45 * 60),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: child timed out (backend hang?)"
+            continue  # a hang is retryable; the budget bounds us
+        out = proc.stdout or ""
+        parsed = _parse_last_json(out)
+        if proc.returncode == 0 and parsed is not None:
+            print(json.dumps(parsed))
+            return 0
+        tail = ((proc.stderr or "") + out)[-6000:]
+        last_err = tail
+        low = tail.lower()
+        if "resource_exhausted" in low and oom_level < 2:
+            oom_level += 1  # immediate retry one rung down the config ladder
+            continue
+        if any(pat in low for pat in RETRYABLE):
+            time.sleep(30)
+            continue
+        break  # deterministic failure: don't burn the budget
     print(
         json.dumps(
             {
-                "metric": "llama_fsdp_train_tokens_per_sec_per_chip",
-                "value": round(tok, 1),
-                "unit": (
-                    f"tokens/s/chip (bf16, {n_params/1e6:.0f}M params, seq 2048, "
-                    f"flash+dots-remat, MFU {mfu:.3f}{extra})"
-                ),
-                "vs_baseline": round(mfu / 0.45, 3),
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "ERROR: benchmark failed after retries (see error field)",
+                "vs_baseline": 0.0,
+                "error": last_err[-2500:],
             }
         )
     )
+    return 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--oom-level", type=int, default=0)
+    args = parser.parse_args()
+    if args.child:
+        return child(args.oom_level)
+    return supervise()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
