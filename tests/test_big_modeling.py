@@ -253,3 +253,105 @@ def test_init_on_device_places_params_on_host():
         params = M().init(jax.random.key(0), jax.numpy.ones((1, 4)))["params"]
     leaf = jax.tree.leaves(params)[0]
     assert next(iter(leaf.devices())) == host
+
+
+# ---------------------------------------------------------------------------
+# Generic layer-streaming (round-3): every family streams, not just Llama/OPT
+# ---------------------------------------------------------------------------
+
+
+def _stream_case(name, scan_layers):
+    """(module, inputs) for each streamed family at tiny scale."""
+    rng = np.random.default_rng(0)
+    if name == "neox":
+        from accelerate_tpu.models import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        cfg = GPTNeoXConfig.tiny(dtype=jnp.float32, scan_layers=scan_layers)
+        ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        return GPTNeoXForCausalLM(cfg), (ids,)
+    if name == "gpt2":
+        from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=scan_layers)
+        ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        return GPT2LMHeadModel(cfg), (ids,)
+    if name == "mixtral":
+        from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig.tiny(dtype=jnp.float32, scan_layers=scan_layers)
+        ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        return MixtralForCausalLM(cfg), (ids,)
+    if name == "t5":
+        from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+        cfg = T5Config.tiny(dtype=jnp.float32, scan_layers=scan_layers)
+        enc = rng.integers(1, cfg.vocab_size, (2, 10)).astype(np.int32)
+        dec = rng.integers(1, cfg.vocab_size, (2, 8)).astype(np.int32)
+        return T5ForConditionalGeneration(cfg), (enc, dec)
+    if name == "whisper":
+        from accelerate_tpu.models import WhisperConfig, WhisperForConditionalGeneration
+
+        cfg = WhisperConfig.tiny(dtype=jnp.float32, scan_layers=scan_layers)
+        feats = rng.normal(size=(2, 24, cfg.num_mel_bins)).astype(np.float32)
+        dec = rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+        return WhisperForConditionalGeneration(cfg), (feats, dec)
+    raise KeyError(name)
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+@pytest.mark.parametrize("name", ["neox", "gpt2", "mixtral", "t5", "whisper"])
+def test_generic_stream_forward_matches_full(name, scan_layers):
+    module, inputs = _stream_case(name, scan_layers)
+    model = Model.from_flax(module, jax.random.key(0), *inputs)
+    expected = np.asarray(model(*inputs))
+
+    off = cpu_offload(model)
+    got = np.asarray(off(*inputs))
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+    assert off.hbm_resident_bytes() == 0
+    # The streamed path ran (fallback materialization never sets this).
+    assert getattr(off, "last_stream_peak_bytes", None) is not None
+
+
+def test_neox_stream_peak_is_o_two_layers():
+    """VERDICT r2 'done' criterion: dispatched GPT-NeoX peak HBM is O(2
+    layers) + embeddings/head, not O(model)."""
+    from accelerate_tpu.models import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = GPTNeoXConfig.tiny(dtype=jnp.float32, scan_layers=True, num_hidden_layers=8)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    module = GPTNeoXForCausalLM(cfg)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+
+    total = sum(leaf.nbytes for leaf in jax.tree.leaves(model.params))
+    layers = sum(leaf.nbytes for leaf in jax.tree.leaves(model.params["gpt_neox"]["layers"]))
+    non_layer = total - layers
+    per_layer = layers // cfg.num_hidden_layers
+
+    off = cpu_offload(model)
+    off(ids)
+    peak = off.last_stream_peak_bytes
+    assert peak <= non_layer + 3 * per_layer  # double-buffer: <=2 cached + 1 in flight
+    assert peak < total  # strictly better than materializing everything
+
+
+def test_fallback_materialize_warns(caplog):
+    """Families without a stream plan must warn, not silently defeat offload."""
+    import flax.linen as nn
+    import logging as _logging
+
+    class NoPlanNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(x)
+
+    x = np.ones((2, 8), np.float32)
+    model = Model.from_flax(NoPlanNet(), jax.random.key(0), x)
+    off = cpu_offload(model)
+    import accelerate_tpu.big_modeling as bm
+
+    bm._warned_fallback.discard("NoPlanNet")
+    with caplog.at_level(_logging.WARNING):
+        off(x)
+    assert any("no stream plan" in r.message for r in caplog.records)
