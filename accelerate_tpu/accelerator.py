@@ -354,6 +354,16 @@ class Accelerator:
         result = []
         model = next((a for a in args if isinstance(a, Model)), None)
         tx = next((a for a in args if _is_optax_tx(a)), None)
+        if model is not None and self.verify_device_map(model):
+            # Same guard as the reference (accelerator.py:3744-3760): a model
+            # dispatched across HBM/host/disk cannot also be prepared for
+            # distributed training — its params aren't a mesh-shardable tree.
+            raise ValueError(
+                "You can't train a model that has been dispatched with a "
+                "multi-placement device_map (offloaded to cpu/disk). Load the "
+                "model on-device (or shard it with a ParallelismConfig mesh) "
+                "before calling prepare()."
+            )
 
         if model is not None:
             self._prepare_state(model, tx)
@@ -977,8 +987,16 @@ class Accelerator:
                     data = recursively_apply(_adjust, data)
                 else:
                     data = data[: self.gradient_state.remainder]
-        except Exception:
-            pass
+        except (TypeError, IndexError, KeyError) as e:
+            # Un-sliceable payloads keep the reference's forgiving contract,
+            # but a real trimming bug must not vanish silently (VERDICT r2).
+            # Strings only: warning_once's lru_cache keys on its args, and a
+            # live exception instance would defeat dedup AND pin its
+            # traceback (and the gathered tensors it references) forever.
+            logger.warning_once(
+                "gather_for_metrics could not trim the duplicate tail samples "
+                f"({type(e).__name__}: {e}); returning the untrimmed gather."
+            )
         return data
 
     def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
@@ -1013,6 +1031,13 @@ class Accelerator:
         """Advisory on TPU: precision is a compile-time policy applied in the
         step builders; this context exists for API parity and casts eager ops
         via jax default dtype promotion (reference: accelerator.py:3410-3437)."""
+        logger.warning_once(
+            "Accelerator.autocast() is a no-op on TPU: mixed precision is a "
+            "compile-time policy already applied inside prepared steps "
+            "(mixed_precision=%s). Remove the context or keep it for API "
+            "parity — behavior is identical either way.",
+            self.state.mixed_precision,
+        )
         yield
 
     @contextlib.contextmanager
@@ -1148,7 +1173,15 @@ class Accelerator:
         return skip_first_batches(dataloader, num_batches)
 
     def verify_device_map(self, model) -> bool:
-        return False
+        """True when ``model`` was dispatched with a multi-placement device
+        map (reference: accelerator.py:3744-3760 checks for hf_device_map —
+        such models must not also be prepared for distributed training)."""
+        from .big_modeling import DispatchedModel
+
+        if not isinstance(model, DispatchedModel):
+            return False
+        placements = {str(p) for p in model.device_map.values()}
+        return len(placements) > 1
 
     def __deepcopy__(self, memo):
         return self

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import queue
 import random as _pyrandom
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -352,7 +353,6 @@ class _PrefetchIterator:
     _SENTINEL = object()
 
     def __init__(self, source, prefetch_size: int = 2):
-        import queue
 
         self._queue = queue.Queue(maxsize=max(1, prefetch_size))
         self._stop = threading.Event()
@@ -399,7 +399,7 @@ class _PrefetchIterator:
         try:
             while True:
                 self._queue.get_nowait()
-        except Exception:
+        except queue.Empty:
             pass
         self._thread.join(timeout=5)
 
