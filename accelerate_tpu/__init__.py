@@ -53,6 +53,8 @@ from .big_modeling import (  # noqa: E402
     init_empty_weights,
     init_on_device,
     load_checkpoint_and_dispatch,
+    register_stream_plan,
+    register_stream_spec,
 )
 from .inference import (  # noqa: E402
     PipelinedModel,
@@ -61,12 +63,14 @@ from .inference import (  # noqa: E402
     register_pipeline_plan,
 )
 from .generation import (  # noqa: E402
+    EncDecState,
     GenerationConfig,
     KVCache,
     beam_search,
     generate,
     speculative_generate,
     init_cache,
+    register_encdec_generation_plan,
     register_generation_plan,
     sample_logits,
 )

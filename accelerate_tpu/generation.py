@@ -42,7 +42,16 @@ class KVCache(NamedTuple):
 
 
 def _cache_dims(cfg) -> tuple[int, int, int, int]:
-    """(layers, kv_heads, head_dim, max_positions) for any supported config."""
+    """(layers, kv_heads, head_dim, max_positions) for any supported config.
+    For encoder-decoder configs these describe the DECODER self-attention
+    cache; T5's relative positions are unbounded (max_pos = 2**30)."""
+    if hasattr(cfg, "n_dec"):  # T5
+        return cfg.n_dec, cfg.num_heads, cfg.d_kv, 2**30
+    if hasattr(cfg, "decoder_layers"):  # Whisper
+        return (
+            cfg.decoder_layers, cfg.decoder_attention_heads,
+            cfg.decoder_head_dim, cfg.max_target_positions,
+        )
     layers = getattr(cfg, "num_hidden_layers", None) or cfg.n_layer
     kv_heads = (
         getattr(cfg, "num_key_value_heads", None)
@@ -414,6 +423,242 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
+# ---------------------------------------------------------------------------
+# Encoder-decoder plans (T5, Whisper)
+# ---------------------------------------------------------------------------
+#
+# The reference generates with T0pp-11B in its big-model benchmark
+# (reference: benchmarks/big_model_inference/README.md) via transformers'
+# encoder-decoder generate. Here the split is explicit and TPU-shaped:
+# ``encode`` runs ONCE (the encoder module itself + a precomputed
+# cross-attention K/V stack per decoder layer — cross K/V never changes
+# during decoding, so it is part of the encoded state, not the cache);
+# ``decode`` keeps the causal plans' KVCache contract for decoder
+# self-attention, so generate()/beam_search() reuse the same loop.
+
+
+class EncDecState(NamedTuple):
+    cross_k: jax.Array  # (L_dec, B, S_enc, H, D) — fixed for the whole decode
+    cross_v: jax.Array
+    enc_mask: Optional[jax.Array]  # (B, S_enc) key validity, or None
+
+
+def _cross_attend(q, k, v, mask, scale: Optional[float]):
+    """q (B,Sq,H,D) vs encoder k/v (B,Sk,H,D); no causality."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if scale is not None:
+        scores = scores * scale
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _t5_rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _t5_encode(cfg, params, input_ids) -> EncDecState:
+    """Encoder pass + the decoder's cross K/V stack. Reuses the flax encoder
+    module (models/t5.py) — its math is already parity-tested."""
+    from .models.t5 import T5Stack
+
+    input_ids = jnp.asarray(input_ids)
+    mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+    x = jnp.take(params["shared"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
+    enc = T5Stack(cfg, is_decoder=False).apply({"params": params["encoder"]}, x, mask=mask)
+
+    def kv(block_p):
+        k = jnp.einsum("bse,ehd->bshd", enc, block_p["cross_attn"]["k"]["kernel"].astype(enc.dtype))
+        v = jnp.einsum("bse,ehd->bshd", enc, block_p["cross_attn"]["v"]["kernel"].astype(enc.dtype))
+        return k, v
+
+    k0, v0 = kv(params["decoder"]["block_0"])
+    stacked = params["decoder"]["layers"]["block"]
+    krest = jnp.einsum(
+        "bse,lehd->lbshd", enc, stacked["cross_attn"]["k"]["kernel"].astype(enc.dtype)
+    )
+    vrest = jnp.einsum(
+        "bse,lehd->lbshd", enc, stacked["cross_attn"]["v"]["kernel"].astype(enc.dtype)
+    )
+    cross_k = jnp.concatenate([k0[None], krest], axis=0)
+    cross_v = jnp.concatenate([v0[None], vrest], axis=0)
+    return EncDecState(cross_k, cross_v, mask)
+
+
+def _t5_self_bias(cfg, table, q_positions, t_max):
+    """Causal relative-position bias against the full cache axis.
+    table: (num_buckets, H). Returns (B, H, Sq, T_max) fp32."""
+    from .models.t5 import relative_position_bucket
+
+    kv_pos = jnp.arange(t_max, dtype=jnp.int32)  # (T,)
+    rel = kv_pos[None, None, :] - q_positions[:, :, None]  # (B, Sq, T)
+    buckets = relative_position_bucket(
+        rel, bidirectional=False,
+        num_buckets=cfg.relative_attention_num_buckets,
+        max_distance=cfg.relative_attention_max_distance,
+    )
+    bias = jnp.take(table, buckets, axis=0)  # (B, Sq, T, H)
+    return jnp.transpose(bias, (0, 3, 1, 2)).astype(jnp.float32)
+
+
+def _t5_decode(cfg, params, input_ids, cache: KVCache, enc: EncDecState, return_all=False):
+    """Cached T5 decoder: block_0 (bias owner) + lax.scan over the stacked
+    rest — exactly the T5Stack split (models/t5.py). No 1/sqrt(d) scaling
+    (T5's initializer absorbs it); scores and softmax in fp32."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    dec = params["decoder"]
+    shared = params["shared"]["embedding"]
+    eps = cfg.layer_norm_epsilon
+
+    b, s = input_ids.shape
+    t_max = cache.k.shape[2]
+    start = cache.length
+    positions = jnp.broadcast_to(start + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+    y = jnp.take(shared, input_ids, axis=0).astype(cfg.dtype)
+    bias_table = dec["block_0"]["self_attn"]["relative_attention_bias"]["embedding"]
+    self_bias = _t5_self_bias(cfg, bias_table, positions, t_max)  # (B,H,Sq,T)
+
+    def self_attend(q, ck, cv):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) + self_bias
+        kv_pos = jnp.arange(t_max, dtype=jnp.int32)[None, :]
+        causal = kv_pos[None, :, :] <= positions[:, :, None]  # (B,Sq,T)
+        scores = jnp.where(causal[:, None], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+    def block(h, p, ck, cv, xk, xv):
+        a = p["self_attn"]
+        hn = _t5_rms(h, p["ln0"]["weight"].astype(h.dtype), eps)
+        q = _proj(hn, a["q"]["kernel"])
+        k_new = _proj(hn, a["k"]["kernel"])
+        v_new = _proj(hn, a["v"]["kernel"])
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = self_attend(q, ck, cv)
+        h = h + _out_proj(out, a["o"]["kernel"])
+
+        c = p["cross_attn"]
+        hn = _t5_rms(h, p["ln1"]["weight"].astype(h.dtype), eps)
+        q = _proj(hn, c["q"]["kernel"])
+        out = _cross_attend(q, xk, xv, enc.enc_mask, scale=None)  # T5: no scaling
+        h = h + _out_proj(out, c["o"]["kernel"])
+
+        hn = _t5_rms(h, p["ln2"]["weight"].astype(h.dtype), eps)
+        mid = jax.nn.relu(hn @ p["ffn"]["wi"]["kernel"].astype(hn.dtype))
+        return h + mid @ p["ffn"]["wo"]["kernel"].astype(mid.dtype), ck, cv
+
+    # block_0 owns cache slot 0; the scan covers slots 1..L-1.
+    y, ck0, cv0 = block(
+        y, dec["block_0"], cache.k[0], cache.v[0], enc.cross_k[0], enc.cross_v[0]
+    )
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv, xk, xv = layer
+        h, ck, cv = block(h, p, ck, cv, xk, xv)
+        return h, (ck, cv)
+
+    y, (krest, vrest) = jax.lax.scan(
+        one_layer,
+        y,
+        (dec["layers"]["block"], cache.k[1:], cache.v[1:], enc.cross_k[1:], enc.cross_v[1:]),
+    )
+    new_k = jnp.concatenate([ck0[None], krest], axis=0)
+    new_v = jnp.concatenate([cv0[None], vrest], axis=0)
+
+    y = _t5_rms(y, dec["final_ln"]["weight"].astype(y.dtype), eps)
+    h_out = y if return_all else y[:, -1]
+    logits = (h_out * (cfg.d_model ** -0.5)) @ shared.T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
+def _whisper_encode(cfg, params, input_features) -> EncDecState:
+    """Whisper encoder (the flax module itself) + cross K/V per decoder layer."""
+    from .models.whisper import WhisperEncoder
+
+    enc = WhisperEncoder(cfg).apply(
+        {"params": params["encoder"]}, jnp.asarray(input_features)
+    )
+    stacked = params["decoder"]["layers"]["block"]["encoder_attn"]
+    k = jnp.einsum("bse,lehd->lbshd", enc, stacked["k_proj"]["kernel"].astype(enc.dtype))
+    v = jnp.einsum("bse,lehd->lbshd", enc, stacked["v_proj"]["kernel"].astype(enc.dtype))
+    v = v + stacked["v_proj"]["bias"][:, None, None].astype(v.dtype)
+    return EncDecState(k, v, None)
+
+
+def _whisper_decode(cfg, params, input_ids, cache: KVCache, enc: EncDecState, return_all=False):
+    """Cached Whisper decoder (mirrors models/whisper.py: pre-LN blocks,
+    learned positions, biased q/v projections, no K bias, tied head)."""
+    if not cfg.scan_layers:
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    dec = params["decoder"]
+    stacked = dec["layers"]["block"]
+    embed = dec["embed_tokens"]["embedding"]
+    eps = cfg.layer_norm_eps
+    d = cfg.decoder_head_dim
+    scale = 1.0 / np.sqrt(d)
+
+    b, s = input_ids.shape
+    start = cache.length
+    positions = jnp.broadcast_to(start + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+    y = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
+    y = y + jnp.take(dec["embed_positions"]["embedding"], positions[0], axis=0).astype(cfg.dtype)
+
+    def proj_b(x, p):  # DenseGeneral with bias
+        return _proj(x, p["kernel"]) + p["bias"].astype(x.dtype)
+
+    def one_layer(carry, layer):
+        h = carry
+        p, ck, cv, xk, xv = layer
+        a = p["self_attn"]
+        hn = _layer_norm(h, p["self_attn_layer_norm"], eps)
+        q = proj_b(hn, a["q_proj"])  # _attend applies the 1/sqrt(d) scale
+        k_new = _proj(hn, a["k_proj"]["kernel"])  # Whisper: no K bias
+        v_new = proj_b(hn, a["v_proj"])
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        out = _attend(q, ck, cv, positions)
+        h = h + _out_proj(out, a["out_proj"]["kernel"]) + a["out_proj"]["bias"].astype(h.dtype)
+
+        c = p["encoder_attn"]
+        hn = _layer_norm(h, p["encoder_attn_layer_norm"], eps)
+        q = proj_b(hn, c["q_proj"])
+        out = _cross_attend(q, xk, xv, None, scale=scale)
+        h = h + _out_proj(out, c["out_proj"]["kernel"]) + c["out_proj"]["bias"].astype(h.dtype)
+
+        hn = _layer_norm(h, p["final_layer_norm"], eps)
+        mid = jax.nn.gelu(
+            hn @ p["fc1"]["kernel"].astype(hn.dtype) + p["fc1"]["bias"].astype(hn.dtype),
+            approximate=False,
+        )
+        h = h + mid @ p["fc2"]["kernel"].astype(mid.dtype) + p["fc2"]["bias"].astype(mid.dtype)
+        return h, (ck, cv)
+
+    y, (new_k, new_v) = jax.lax.scan(
+        one_layer, y, (stacked, cache.k, cache.v, enc.cross_k, enc.cross_v)
+    )
+    y = _layer_norm(y, dec["layer_norm"], eps)
+    logits = (y if return_all else y[:, -1]) @ embed.T.astype(cfg.dtype)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
+
+
+# module class name -> (encode(cfg, params, enc_inputs) -> EncDecState,
+#                       decode(cfg, params, ids, cache, enc_state))
+ENCDEC_GENERATION_PLANS: dict[str, tuple] = {
+    "T5ForConditionalGeneration": (_t5_encode, _t5_decode),
+    "WhisperForConditionalGeneration": (_whisper_encode, _whisper_decode),
+}
+
+
+def register_encdec_generation_plan(module_class_name: str, encode_fn, decode_fn) -> None:
+    ENCDEC_GENERATION_PLANS[module_class_name] = (encode_fn, decode_fn)
+
+
 # module class name -> forward_cached(cfg, params, ids, cache)
 GENERATION_PLANS: dict[str, Callable] = {
     "LlamaForCausalLM": _llama_forward_cached,
@@ -441,6 +686,44 @@ class GenerationConfig:
     pad_token_id: Optional[int] = None  # finished rows get this (default: eos)
 
 
+def _resolve_encdec(model, inputs, decoder_input_ids, beams: int = 1):
+    """If ``model`` is an encoder-decoder family, run its encoder and return
+    ``(decoder_ids, fwd)`` where ``fwd`` has the causal plans' signature with
+    the encoded state closed over. Otherwise ``(None, None)``.
+
+    ``beams > 1``: ``fwd`` dispatches on the batch dim — prefill sees B rows,
+    decode sees B*beams — selecting the plain or beam-tiled encoded state.
+    """
+    name = type(model.module).__name__
+    plan = ENCDEC_GENERATION_PLANS.get(name)
+    if plan is None:
+        return None, None
+    encode_fn, decode_fn = plan
+    cfg = model.module.config
+    if not getattr(cfg, "scan_layers", True):
+        # Same early diagnostic as the decode fns — the encoders also slice
+        # the stacked (scan) layer layout for the cross K/V.
+        raise ValueError("generation requires scan_layers=True (stacked blocks)")
+    enc_state = jax.jit(partial(encode_fn, cfg))(model.params, inputs)
+    if decoder_input_ids is None:
+        b = jnp.asarray(inputs).shape[0]
+        start_id = getattr(cfg, "decoder_start_token_id", 0)
+        decoder_input_ids = jnp.full((b, 1), start_id, jnp.int32)
+    states = {enc_state.cross_k.shape[1]: enc_state}
+    if beams > 1:
+        tiled = EncDecState(
+            jnp.repeat(enc_state.cross_k, beams, axis=1),
+            jnp.repeat(enc_state.cross_v, beams, axis=1),
+            None if enc_state.enc_mask is None else jnp.repeat(enc_state.enc_mask, beams, axis=0),
+        )
+        states[tiled.cross_k.shape[1]] = tiled
+
+    def fwd(cfg, params, ids, cache, return_all=False):
+        return decode_fn(cfg, params, ids, cache, states[ids.shape[0]], return_all)
+
+    return jnp.asarray(decoder_input_ids), fwd
+
+
 def generate(
     model,
     input_ids,
@@ -454,6 +737,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     forward_cached: Optional[Callable] = None,
     config: Optional[GenerationConfig] = None,
+    decoder_input_ids=None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for ``input_ids`` (B, S).
 
@@ -461,6 +745,12 @@ def generate(
     token). Returns (B, S + max_new_tokens); after a row emits
     ``eos_token_id`` it is padded with ``pad_token_id`` (defaulting to the
     EOS id, like transformers' warning-fallback).
+
+    Encoder-decoder families (T5, Whisper): ``input_ids`` is the ENCODER
+    input (token ids / mel features), the encoder runs once, and the decode
+    loop starts from ``decoder_input_ids`` (default: one
+    ``decoder_start_token_id`` per row — pass Whisper's forced SOT prompt
+    here). Returns the decoder sequence (B, S_dec + max_new_tokens).
     """
     gc = config or GenerationConfig()
     max_new_tokens = gc.max_new_tokens if max_new_tokens is None else max_new_tokens
@@ -473,9 +763,18 @@ def generate(
         pad_token_id = eos_token_id
     cfg = model.module.config
     params = model.params
-    fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
+    # An explicit forward_cached override outranks the registries, exactly as
+    # on the causal path.
+    dec_ids, encdec_fwd = (
+        (None, None) if forward_cached is not None
+        else _resolve_encdec(model, input_ids, decoder_input_ids)
+    )
+    if encdec_fwd is not None:
+        input_ids, fwd = dec_ids, encdec_fwd
+    else:
+        fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
     if fwd is None:
-        known = ", ".join(sorted(GENERATION_PLANS))
+        known = ", ".join(sorted(GENERATION_PLANS) + sorted(ENCDEC_GENERATION_PLANS))
         raise ValueError(
             f"No generation plan for {type(model.module).__name__!r}; built-in: {known}"
         )
@@ -627,6 +926,7 @@ def beam_search(
     length_penalty: float = 1.0,
     eos_token_id: Optional[int] = None,
     forward_cached: Optional[Callable] = None,
+    decoder_input_ids=None,
 ) -> jax.Array:
     """Beam-search decoding over the same KV-cache plans as :func:`generate`.
 
@@ -636,13 +936,22 @@ def beam_search(
     ``K×V`` candidates, reordering the cache along the beam axis. Beams that
     emit ``eos_token_id`` freeze (their score stops accumulating; the eos is
     kept, later slots pad with it). Returns the single best sequence per
-    batch row, shape (B, S + max_new_tokens).
+    batch row, shape (B, S + max_new_tokens). Encoder-decoder families
+    follow :func:`generate`'s contract (``input_ids`` feeds the encoder, the
+    returned sequence is the decoder's).
     """
     cfg = model.module.config
     params = model.params
-    fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
+    dec_ids, encdec_fwd = (
+        (None, None) if forward_cached is not None
+        else _resolve_encdec(model, input_ids, decoder_input_ids, beams=num_beams)
+    )
+    if encdec_fwd is not None:
+        input_ids, fwd = dec_ids, encdec_fwd
+    else:
+        fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
     if fwd is None:
-        known = ", ".join(sorted(GENERATION_PLANS))
+        known = ", ".join(sorted(GENERATION_PLANS) + sorted(ENCDEC_GENERATION_PLANS))
         raise ValueError(
             f"No generation plan for {type(model.module).__name__!r}; built-in: {known}"
         )

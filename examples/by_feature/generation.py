@@ -1,5 +1,7 @@
 """Feature: KV-cache autoregressive generation (accelerate_tpu.generate) —
-greedy vs sampled continuations from the same tiny model."""
+greedy vs sampled continuations from a causal model, plus the
+encoder-decoder path (T5): encoder runs once, cross-attention K/V is
+precomputed, and the decode loop reuses the same cache contract."""
 
 import numpy as np
 
@@ -32,6 +34,18 @@ def main():
     assert bool((greedy[:, -1] == nxt).all())
     print(f"greedy tail: {np.asarray(greedy[0, 8:]).tolist()}")
     print(f"sampled tail: {np.asarray(sampled[0, 8:]).tolist()}")
+
+    # Encoder-decoder: input_ids feed the ENCODER; generation returns the
+    # decoder sequence starting from decoder_start_token_id.
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+    t5_cfg = T5Config.tiny(dtype=jnp.float32)
+    t5 = T5ForConditionalGeneration(t5_cfg)
+    enc_ids = rng.integers(1, t5_cfg.vocab_size, size=(2, 10), dtype=np.int32)
+    t5_model = Model.from_flax(t5, jax.random.key(args.seed), enc_ids, enc_ids[:, :1])
+    dec = generate(t5_model, enc_ids, max_new_tokens=8)
+    assert dec.shape == (2, 9)  # start token + 8 generated
+    print(f"t5 decode: {np.asarray(dec[0]).tolist()}")
     print("generation OK")
 
 
