@@ -7,7 +7,16 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: test test_core test_models test_parallel test_big_modeling test_cli \
         test_examples test_checkpointing test_hub quality bench
 
+# Parallel across available cores (pytest-xdist): launched subprocess tests
+# draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
+# workers never collide — the role of the reference's unique-port trick
+# (test_utils/testing.py:810-820). On single-core boxes the wall-clock lever
+# is the persistent XLA compile cache conftest.py sets up instead
+# (/tmp/accelerate_tpu_test_cache): warm runs skip every repeated compile.
 test:
+	$(PYTEST) -n auto tests/
+
+test_serial:
 	$(PYTEST) tests/
 
 # Runtime + ops + data + training loop (excludes models/examples/big-model).
