@@ -76,3 +76,9 @@ from .whisper import (
     WhisperForConditionalGeneration,
     whisper_tp_rules,
 )
+from .megatron import (
+    load_megatron_checkpoint,
+    megatron_config_from_args,
+    megatron_core_params_to_llama,
+    merge_megatron_tp_shards,
+)

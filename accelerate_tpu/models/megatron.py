@@ -1,0 +1,276 @@
+"""Megatron-LM checkpoint importer.
+
+The reference ships a full Megatron-LM *engine adapter*
+(reference: utils/megatron_lm.py, 1,248 LoC driving megatron.core's
+process-group runtime). Here the capabilities that adapter provides — TP/PP
+degrees, fused kernels, distributed optimizer — are native mesh features, so
+what remains of the integration surface is checkpoint portability: take a
+Megatron-saved GPT/Llama model and run (or fine-tune) it on the mesh.
+
+Scope: the **megatron-core** GPT layout (``model.decoder.layers.N...``):
+``linear_qkv`` fused per GQA group ``[ng * (q_per_group + 2) * hn, h]``
+(queries of the group, then its K, then its V), ``linear_fc1`` as
+gate-then-up halves for SwiGLU, RMSNorm weights, rotary positions — maps
+onto :class:`LlamaConfig`. The legacy
+``language_model.encoder.*`` layout is NOT converted (its names appear in
+the TP-merge rules only so merged legacy dicts are at least
+partition-correct for custom converters).
+
+TP-sharded checkpoints (``mp_rank_00 ... mp_rank_0{T-1}``) merge before
+conversion: column-parallel weights concat on the output dim, row-parallel on
+the input dim, per Megatron's partitioning rules — EXCEPT SwiGLU's fc1,
+where each rank holds its own ``[gate_r; up_r]`` halves (the glu chunks the
+*local* output), so gate and up merge separately. Pipeline-parallel
+checkpoints (``mp_rank_XX_YYY`` dirs, per-stage layer numbering) are
+rejected with a clear error.
+
+Verified by inverse-roundtrip tests (tests/test_megatron.py) — synthetic
+checkpoints in these layouts convert to logit-parity with the native modules;
+real-checkpoint fidelity shares whatever fidelity these layout notes have.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "load_megatron_checkpoint",
+    "merge_megatron_tp_shards",
+    "megatron_config_from_args",
+    "megatron_core_params_to_llama",
+]
+
+
+# ---------------------------------------------------------------------------
+# Reading checkpoint directories
+# ---------------------------------------------------------------------------
+
+
+def _latest_iteration(root: str) -> str:
+    """Resolve ``<root>`` to its newest ``iter_XXXXXXX`` subdir (or itself)."""
+    tracker = os.path.join(root, "latest_checkpointed_iteration.txt")
+    if os.path.isfile(tracker):
+        with open(tracker) as f:
+            it = f.read().strip()
+        sub = os.path.join(root, "release" if it == "release" else f"iter_{int(it):07d}")
+        if os.path.isdir(sub):
+            return sub
+    iters = sorted(
+        (d for d in os.listdir(root) if re.fullmatch(r"iter_\d{7}", d))
+    ) if os.path.isdir(root) else []
+    return os.path.join(root, iters[-1]) if iters else root
+
+
+def _flatten_torch_tree(obj, prefix="") -> dict[str, np.ndarray]:
+    """Flatten Megatron's nested-dict-of-tensors into dotted numpy arrays."""
+    out: dict[str, np.ndarray] = {}
+    if hasattr(obj, "detach"):  # torch.Tensor without importing torch here
+        out[prefix.rstrip(".")] = np.asarray(obj.detach().to("cpu").float().numpy())
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten_torch_tree(v, f"{prefix}{k}."))
+    return out
+
+
+def load_megatron_checkpoint(path: str) -> tuple[list[dict[str, np.ndarray]], Any]:
+    """Load a Megatron checkpoint directory into per-TP-rank flat dicts.
+
+    ``path`` may be the experiment root (``latest_checkpointed_iteration.txt``
+    resolves the iteration), an ``iter_*`` dir holding ``mp_rank_*``
+    subdirs, or a single ``.pt`` file. Returns ``(shards, args)``: one flat
+    ``{dotted_name: np.ndarray}`` per TP rank in rank order (pass to
+    :func:`merge_megatron_tp_shards`) plus the checkpoint's stored Megatron
+    ``args`` (for :func:`megatron_config_from_args`; None if absent).
+    """
+    import torch
+
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        it_dir = _latest_iteration(path)
+        ranks = sorted(d for d in os.listdir(it_dir) if d.startswith("mp_rank_"))
+        if not ranks:
+            raise FileNotFoundError(f"no mp_rank_* dirs under {it_dir}")
+        if any(re.fullmatch(r"mp_rank_\d+_\d+", r) for r in ranks):
+            raise NotImplementedError(
+                "pipeline-parallel Megatron checkpoints (mp_rank_XX_YYY dirs, "
+                "per-stage layer numbering) are not supported — merge PP "
+                "stages with Megatron's own tools first"
+            )
+        files = []
+        for r in ranks:
+            for name in ("model_optim_rng.pt", "model_rng.pt"):
+                p = os.path.join(it_dir, r, name)
+                if os.path.isfile(p):
+                    files.append(p)
+                    break
+            else:
+                raise FileNotFoundError(f"no checkpoint file under {it_dir}/{r}")
+    shards, args = [], None
+    for f in files:
+        payload = torch.load(f, map_location="cpu", weights_only=False)
+        model = payload.get("model", payload) if isinstance(payload, dict) else payload
+        if isinstance(payload, dict) and args is None:
+            args = payload.get("args")
+        shards.append(_flatten_torch_tree(model))
+    return shards, args
+
+
+# Column-parallel (concat dim 0 of the torch [out, in] weight): QKV, fc1/h_to_4h,
+# output_layer, embeddings (vocab-parallel). Row-parallel (concat dim 1):
+# attention out-proj, fc2/4h_to_h. Norms/biases-of-row-parallel are replicated.
+_COL_PAT = re.compile(
+    r"(linear_qkv|query_key_value|linear_fc1|dense_h_to_4h|output_layer|word_embeddings)\.weight$"
+)
+_COL_BIAS_PAT = re.compile(r"(linear_qkv|query_key_value|linear_fc1|dense_h_to_4h)\.bias$")
+_ROW_PAT = re.compile(r"(linear_proj|dense|linear_fc2|dense_4h_to_h)\.weight$")
+
+
+_FC1_PAT = re.compile(r"(linear_fc1|dense_h_to_4h)\.(weight|bias)$")
+
+
+def merge_megatron_tp_shards(
+    shards: list[dict[str, np.ndarray]], swiglu: bool = True
+) -> dict[str, np.ndarray]:
+    """Merge per-TP-rank flat dicts into one full dict (Megatron partition
+    rules: column-parallel concat on dim 0, row-parallel on dim 1).
+
+    ``swiglu=True`` (megatron-core Llama default): each rank's fc1 holds its
+    own ``[gate_r; up_r]`` halves — the glu activation chunks the LOCAL
+    output — so a naive dim-0 concat would interleave ``[g0,u0,g1,u1,...]``.
+    Gate halves and up halves merge separately instead. Set ``swiglu=False``
+    for GELU-MLP checkpoints where fc1 is plain column-parallel.
+    """
+    if len(shards) == 1:
+        return dict(shards[0])
+    merged: dict[str, np.ndarray] = {}
+    for name in shards[0]:
+        parts = [s[name] for s in shards]
+        if swiglu and _FC1_PAT.search(name):
+            gates, ups = zip(*(np.split(p, 2, axis=0) for p in parts))
+            merged[name] = np.concatenate(list(gates) + list(ups), axis=0)
+        elif _COL_PAT.search(name) or _COL_BIAS_PAT.search(name):
+            merged[name] = np.concatenate(parts, axis=0)
+        elif _ROW_PAT.search(name):
+            merged[name] = np.concatenate(parts, axis=1)
+        else:
+            merged[name] = parts[0]  # replicated (norms, row-parallel biases)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# megatron-core GPT (Llama-style) -> LlamaForCausalLM params
+# ---------------------------------------------------------------------------
+
+
+def megatron_config_from_args(args: Any) -> "LlamaConfig":
+    """Map a Megatron ``args`` namespace/dict (as stored in the checkpoint
+    payload) onto :class:`LlamaConfig`."""
+    from .llama import LlamaConfig
+
+    get = (lambda k, d=None: args.get(k, d)) if isinstance(args, dict) else (
+        lambda k, d=None: getattr(args, k, d)
+    )
+    heads = get("num_attention_heads")
+    return LlamaConfig(
+        vocab_size=get("padded_vocab_size") or get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("ffn_hidden_size"),
+        num_hidden_layers=get("num_layers"),
+        num_attention_heads=heads,
+        num_key_value_heads=get("num_query_groups") or heads,
+        head_dim=get("kv_channels"),  # None -> hidden_size // heads
+        max_position_embeddings=get("max_position_embeddings", 4096),
+        rms_norm_eps=get("norm_epsilon", 1e-5),
+        rope_theta=get("rotary_base", 10000.0),
+        tie_word_embeddings=not get("untie_embeddings_and_output_weights", False),
+        attention_bias=bool(get("add_qkv_bias", False)),
+    )
+
+
+def megatron_core_params_to_llama(cfg, sd: dict[str, np.ndarray]) -> dict:
+    """Convert a merged megatron-core GPT flat dict to LlamaForCausalLM params
+    (stacked ``nn.scan`` layout when ``cfg.scan_layers``).
+
+    Layout notes (see module docstring): fused QKV is per-GQA-group
+    ``[ng, (q_per_group + 2) * hn, h]`` rows ordered q...q k v; fc1 is
+    ``[gate; up]`` halves; torch Linear weights are ``[out, in]`` so every
+    2-D kernel transposes.
+    """
+    h = cfg.hidden_size
+    hn = cfg.head_dim
+    nq = cfg.num_attention_heads
+    ng = cfg.num_key_value_heads
+    q_per_g = nq // ng
+
+    def t(name):
+        return sd[name].T  # [out, in] -> [in, out]
+
+    def layer(i: int) -> dict:
+        p = f"decoder.layers.{i}."
+        qkv = sd[p + "self_attention.linear_qkv.weight"]  # [(ng*(q+2)*hn), h]
+        grouped = qkv.reshape(ng, (q_per_g + 2) * hn, h)
+        q = grouped[:, : q_per_g * hn].reshape(nq * hn, h)
+        k = grouped[:, q_per_g * hn : (q_per_g + 1) * hn].reshape(ng * hn, h)
+        v = grouped[:, (q_per_g + 1) * hn :].reshape(ng * hn, h)
+        attn = {
+            "q_proj": {"kernel": q.T.reshape(h, nq, hn)},
+            "k_proj": {"kernel": k.T.reshape(h, ng, hn)},
+            "v_proj": {"kernel": v.T.reshape(h, ng, hn)},
+            "o_proj": {"kernel": t(p + "self_attention.linear_proj.weight").reshape(nq, hn, h)},
+        }
+        bias_name = p + "self_attention.linear_qkv.bias"
+        if bias_name in sd:
+            # add_qkv_bias (Qwen-style): slice the fused bias like the weight.
+            b = sd[bias_name].reshape(ng, (q_per_g + 2) * hn)
+            attn["q_proj"]["bias"] = b[:, : q_per_g * hn].reshape(nq, hn)
+            attn["k_proj"]["bias"] = b[:, q_per_g * hn : (q_per_g + 1) * hn].reshape(ng, hn)
+            attn["v_proj"]["bias"] = b[:, (q_per_g + 1) * hn :].reshape(ng, hn)
+        fc1 = sd[p + "mlp.linear_fc1.weight"]  # [2*ffn, h]: gate then up
+        gate, up = np.split(fc1, 2, axis=0)
+        return {
+            "input_layernorm": {"weight": sd[p + "self_attention.linear_qkv.layer_norm_weight"]
+                                if p + "self_attention.linear_qkv.layer_norm_weight" in sd
+                                else sd[p + "input_layernorm.weight"]},
+            "post_attention_layernorm": {"weight": sd[p + "mlp.linear_fc1.layer_norm_weight"]
+                                         if p + "mlp.linear_fc1.layer_norm_weight" in sd
+                                         else sd[p + "pre_mlp_layernorm.weight"]},
+            "self_attn": attn,
+            "mlp": {
+                "gate_proj": {"kernel": gate.T},
+                "up_proj": {"kernel": up.T},
+                "down_proj": {"kernel": t(p + "mlp.linear_fc2.weight")},
+            },
+        }
+
+    layers = [layer(i) for i in range(cfg.num_hidden_layers)]
+    if cfg.scan_layers:
+        stacked = {"block": _stack(layers)}
+    else:
+        stacked = {f"layers_{i}": l for i, l in enumerate(layers)}
+        # non-scan layout stores blocks as siblings of embed/norm
+    model = {
+        "embed_tokens": {"embedding": sd["embedding.word_embeddings.weight"]},
+        "norm": {"weight": sd["decoder.final_layernorm.weight"]},
+    }
+    if cfg.scan_layers:
+        model["layers"] = stacked
+    else:
+        model.update(stacked)
+    params = {"model": model}
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": sd["output_layer.weight"].T}
+    return params
+
+
+def _stack(per_layer: list[dict]) -> dict:
+    """Stack per-layer nested dicts into the nn.scan layout — pure numpy (no
+    jax init needed for a checkpoint conversion)."""
+    first = per_layer[0]
+    if isinstance(first, dict):
+        return {k: _stack([layer[k] for layer in per_layer]) for k in first}
+    return np.stack(per_layer, axis=0)

@@ -305,11 +305,14 @@ def supervise() -> int:
             break
         cmd = [sys.executable, os.path.abspath(__file__), "--child", f"--oom-level={oom_level}"]
         try:
+            # A healthy child (both seqs, incl. remote compiles) finishes well
+            # under 20 min; a hung backend otherwise burns the whole budget
+            # before the first retry.
             proc = subprocess.run(
                 cmd,
                 capture_output=True,
                 text=True,
-                timeout=min(remaining, 45 * 60),
+                timeout=min(remaining, 20 * 60),
             )
         except subprocess.TimeoutExpired:
             last_err = f"attempt {attempt}: child timed out (backend hang?)"
