@@ -97,10 +97,11 @@ def _mlp(cfg, p, x):
     return (act(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
 
 
-def _attend(q, k, v, q_positions):
-    """q (B,Sq,Hq,D) vs cached k/v (B,T,Hkv,D); causal wrt absolute positions.
-    The causal bound kv_pos <= q_position also excludes unwritten cache slots
-    (every query position is < cache length after the write)."""
+def _attend(q, k, v, q_positions, kv_valid=None):
+    """q (B,Sq,Hq,D) vs cached k/v (B,T,Hkv,D); causal wrt absolute cache
+    slots. The causal bound kv_pos <= q_position also excludes unwritten
+    cache slots (every query position is < cache length after the write).
+    ``kv_valid`` (B, T) additionally masks slots holding left-padding."""
     hq, hkv = q.shape[2], k.shape[2]
     if hq != hkv:
         rep = hq // hkv
@@ -111,15 +112,24 @@ def _attend(q, k, v, q_positions):
     t = k.shape[1]
     kv_pos = jnp.arange(t, dtype=jnp.int32)[None, :]  # (1, T)
     causal = kv_pos[None, :, :] <= q_positions[:, :, None]  # (B, Sq, T)
+    if kv_valid is not None:
+        causal = causal & kv_valid[:, None, :].astype(bool)
     logits = jnp.where(causal[:, None], logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
+def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False,
+                          pad_offset=None, kv_valid=None):
     """Run ``input_ids`` (appended at cache.length) through all layers,
     returning (logits, new_cache) — last-token logits, or every position's
-    with ``return_all`` (speculative verification needs them)."""
+    with ``return_all`` (speculative verification needs them).
+
+    Left-padded batches (the transformers convention): ``pad_offset`` (B,)
+    counts each row's leading pads — RoPE positions shift down by it so row
+    content starts at position 0 — and ``kv_valid`` (B, T_max) masks the pad
+    slots out of attention forever.
+    """
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     model_p = params["model"] if "model" in params else params
@@ -135,7 +145,10 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
     if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
         x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
+    rope_positions = positions
+    if pad_offset is not None:
+        rope_positions = jnp.maximum(positions - pad_offset[:, None], 0)
+    cos, sin = rotary_embedding(rope_positions, cfg.head_dim, cfg.rope_theta, x.dtype)
     plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
 
     def norm_w(w, like):
@@ -158,7 +171,7 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         v_new = qkv("v_proj")
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
-        out = _attend(q, ck, cv, positions)
+        out = _attend(q, ck, cv, positions, kv_valid)
         h = h + _out_proj(out, attn["o_proj"]["kernel"])
         hn = rms_norm(h, norm_w(p["post_attention_layernorm"]["weight"], h), cfg.rms_norm_eps)
         h = h + _mlp(cfg, p["mlp"], hn)
@@ -738,8 +751,13 @@ def generate(
     forward_cached: Optional[Callable] = None,
     config: Optional[GenerationConfig] = None,
     decoder_input_ids=None,
+    attention_mask=None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for ``input_ids`` (B, S).
+
+    ``attention_mask`` (B, S): transformers' left-padded-batch convention —
+    rows shorter than S carry leading pads marked 0. RoPE positions shift
+    per row so content starts at 0 and pad slots never enter attention.
 
     One jitted prefill + one jitted decode step (compiled once, reused every
     token). Returns (B, S + max_new_tokens); after a row emits
@@ -787,6 +805,36 @@ def generate(
             f"{t_max} tokens exceeds max_position_embeddings={max_pos}"
         )
     rng = rng if rng is not None else jax.random.key(0)
+
+    if attention_mask is not None:
+        import inspect
+
+        if "pad_offset" not in inspect.signature(fwd).parameters:
+            raise ValueError(
+                f"the generation plan for {type(model.module).__name__!r} does "
+                "not support attention_mask (left-padded batches) yet"
+            )
+        mask = jnp.asarray(attention_mask, jnp.int32)
+        pad_offset = jnp.argmax(mask, axis=1).astype(jnp.int32)  # leading pads per row
+        # Decoder-only generation requires LEFT padding (transformers warns
+        # about the same mistake): right/ragged masks would silently read the
+        # next-token logits off a pad-position query.
+        if not bool(jnp.all(pad_offset + mask.sum(axis=1) == s)):
+            raise ValueError(
+                "attention_mask must be left-padded (zeros then ones per row) "
+                "for decoder-only generation; got a right-padded or "
+                "non-contiguous mask. Re-tokenize with padding_side='left'."
+            )
+        kv_valid = jnp.concatenate(
+            [mask.astype(bool), jnp.ones((b, t_max - s), bool)], axis=1
+        )
+        base_fwd = fwd
+
+        def fwd(cfg, params, ids, cache, return_all=False):
+            return base_fwd(
+                cfg, params, ids, cache, return_all,
+                pad_offset=pad_offset, kv_valid=kv_valid,
+            )
 
     cache = init_cache(cfg, b, t_max)
     prefill = jax.jit(partial(fwd, cfg))

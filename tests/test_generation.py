@@ -500,3 +500,69 @@ def test_whisper_hub_transcribe_parity():
         max_new_tokens=5, decoder_input_ids=prompt.astype(np.int32),
     )
     np.testing.assert_array_equal(np.asarray(got), dec.astype(np.int32))
+
+
+def test_llama_padded_batch_matches_transformers():
+    """Left-padded batch + attention_mask: greedy tokens match HF exactly
+    (the first practical thing a migrating user does with generate)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from accelerate_tpu.models import model_from_pretrained
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False, pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+    rng = np.random.default_rng(7)
+    # Row 0: full 6-token prompt. Row 1: 3 tokens, left-padded with 3 zeros.
+    row0 = rng.integers(1, 96, (6,))
+    row1 = rng.integers(1, 96, (3,))
+    ids = np.stack([row0, np.concatenate([[0, 0, 0], row1])]).astype(np.int64)
+    mask = np.asarray([[1] * 6, [0, 0, 0, 1, 1, 1]], np.int64)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), attention_mask=torch.from_numpy(mask),
+            max_new_tokens=5, do_sample=False, pad_token_id=0,
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(
+        ours, ids.astype(np.int32), max_new_tokens=5,
+        attention_mask=mask.astype(np.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
+
+
+def test_padded_batch_matches_unpadded_row():
+    """A left-padded row must generate the same tokens as the same prompt
+    alone (padding must be invisible)."""
+    llama_model, cfg, _ = _tiny_llama_for_pad()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, cfg.vocab_size, (1, 4)).astype(np.int32)
+    alone = generate(llama_model, prompt, max_new_tokens=6)
+
+    padded = np.concatenate([np.zeros((1, 3), np.int32), prompt], axis=1)
+    mask = np.asarray([[0, 0, 0, 1, 1, 1, 1]], np.int32)
+    batched = generate(llama_model, padded, max_new_tokens=6, attention_mask=mask)
+    np.testing.assert_array_equal(np.asarray(batched)[:, 7:], np.asarray(alone)[:, 4:])
+
+
+def _tiny_llama_for_pad():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    return model, cfg, ids
+
+
+def test_right_padded_mask_rejected():
+    llama_model, cfg, ids = _tiny_llama_for_pad()
+    bad = np.asarray([[1] * 8, [1, 1, 1, 1, 1, 0, 0, 0]], np.int32)
+    with pytest.raises(ValueError, match="left-padded"):
+        generate(llama_model, ids, max_new_tokens=2, attention_mask=bad)
