@@ -224,9 +224,11 @@ def _layer_norm(x, p, eps):
     return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
-def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
+def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False,
+                         pad_offset=None, kv_valid=None):
     """GPT-2 decode with the same cache contract (learned positions, fused
-    c_attn, GELU MLP — mirrors models/gpt2.py)."""
+    c_attn, GELU MLP — mirrors models/gpt2.py). ``pad_offset``/``kv_valid``:
+    left-padded batches (see _llama_forward_cached)."""
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     tr = params["transformer"]
@@ -238,9 +240,12 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
     start = cache.length
     positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions_b = jnp.broadcast_to(positions, (b, s))
+    pos_ids = positions_b
+    if pad_offset is not None:
+        pos_ids = jnp.maximum(positions_b - pad_offset[:, None], 0)
 
     x = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
-    x = x + jnp.take(tr["wpe"]["embedding"], positions[0], axis=0).astype(cfg.dtype)
+    x = x + jnp.take(tr["wpe"]["embedding"], pos_ids, axis=0).astype(cfg.dtype)
 
     def one_layer(carry, layer):
         h = carry
@@ -252,7 +257,7 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
-        out = _attend(q, ck, cv, positions_b)
+        out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + (
             jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["c_proj"]["kernel"].astype(out.dtype))
             + p["attn"]["c_proj"]["bias"].astype(out.dtype)
@@ -270,9 +275,11 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
+def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False,
+                        pad_offset=None, kv_valid=None):
     """OPT decode with the same cache contract (learned positions with the
-    fairseq offset of 2, pre-LN ReLU blocks — mirrors models/opt.py)."""
+    fairseq offset of 2, pre-LN ReLU blocks — mirrors models/opt.py).
+    ``pad_offset``/``kv_valid``: left-padded batches."""
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     model_p = params["model"]
@@ -283,10 +290,13 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
     start = cache.length
     positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions_b = jnp.broadcast_to(positions, (b, s))
+    pos_ids = positions_b
+    if pad_offset is not None:
+        pos_ids = jnp.maximum(positions_b - pad_offset[:, None], 0)
 
     x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
     x = x + jnp.take(
-        model_p["embed_positions"]["embedding"], positions[0] + cfg.POSITION_OFFSET, axis=0
+        model_p["embed_positions"]["embedding"], pos_ids + cfg.POSITION_OFFSET, axis=0
     ).astype(cfg.dtype)
 
     def one_layer(carry, layer):
@@ -299,7 +309,7 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
         v_new = _proj(hn, attn["v_proj"]["kernel"]) + attn["v_proj"]["bias"].astype(hn.dtype)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
-        out = _attend(q, ck, cv, positions_b)
+        out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + _out_proj(out, attn["out_proj"]["kernel"]) + attn["out_proj"]["bias"].astype(h.dtype)
         hn = _layer_norm(h, p["final_layer_norm"], cfg.layer_norm_eps)
         mid = jax.nn.relu(
@@ -314,9 +324,11 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
+def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False,
+                         pad_offset=None, kv_valid=None):
     """GPT-NeoX decode: parallel residual, fused per-head [q|k|v], partial
-    rotary — mirrors models/neox.py."""
+    rotary — mirrors models/neox.py. ``pad_offset``/``kv_valid``: left-padded
+    batches."""
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     gp = params["gpt_neox"]
@@ -326,10 +338,13 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
     start = cache.length
     positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions_b = jnp.broadcast_to(positions, (b, s))
+    rope_positions = positions_b
+    if pad_offset is not None:
+        rope_positions = jnp.maximum(positions_b - pad_offset[:, None], 0)
 
     x = jnp.take(gp["embed_in"]["embedding"], input_ids, axis=0).astype(cfg.dtype)
     rnd = cfg.rotary_ndims
-    cos, sin = rotary_embedding(positions_b, rnd, cfg.rotary_emb_base, x.dtype)
+    cos, sin = rotary_embedding(rope_positions, rnd, cfg.rotary_emb_base, x.dtype)
 
     def one_layer(carry, layer):
         h = carry
@@ -344,7 +359,7 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
         k_new = jnp.concatenate([apply_rope(k_new[..., :rnd], cos, sin), k_new[..., rnd:]], -1)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
-        out = _attend(q, ck, cv, positions_b)
+        out = _attend(q, ck, cv, positions_b, kv_valid)
         attn_out = (
             jnp.einsum("bsnd,ndh->bsh", out, attn["dense"]["kernel"].astype(out.dtype))
             + attn["dense"]["bias"].astype(out.dtype)
@@ -376,9 +391,11 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 
-def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False):
+def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False,
+                            pad_offset=None, kv_valid=None):
     """Mixtral decode: Llama attention + routed sparse-MLP on raw params
-    (mirrors models/moe.py — dropless here since decode batches are tiny)."""
+    (mirrors models/moe.py — dropless here since decode batches are tiny).
+    ``pad_offset``/``kv_valid``: left-padded batches."""
     if not cfg.scan_layers:
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     model_p = params["model"]
@@ -389,9 +406,12 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
     start = cache.length
     positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (b, s))
+    rope_positions = positions
+    if pad_offset is not None:
+        rope_positions = jnp.maximum(positions - pad_offset[:, None], 0)
 
     x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
+    cos, sin = rotary_embedding(rope_positions, cfg.head_dim, cfg.rope_theta, x.dtype)
     k = cfg.num_experts_per_tok
 
     def moe(p, h):
@@ -424,7 +444,7 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
         v_new = _proj(hn, attn["v_proj"]["kernel"])
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
-        out = _attend(q, ck, cv, positions)
+        out = _attend(q, ck, cv, positions, kv_valid)
         h = h + _out_proj(out, attn["o_proj"]["kernel"])
         hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
         h = h + moe(p["moe"], hn)
@@ -812,7 +832,9 @@ def generate(
         if "pad_offset" not in inspect.signature(fwd).parameters:
             raise ValueError(
                 f"the generation plan for {type(model.module).__name__!r} does "
-                "not support attention_mask (left-padded batches) yet"
+                "not take attention_mask. Encoder-decoder families derive the "
+                "encoder mask from pad_token_id automatically; custom plans "
+                "need pad_offset/kv_valid parameters to support padded batches."
             )
         mask = jnp.asarray(attention_mask, jnp.int32)
         pad_offset = jnp.argmax(mask, axis=1).astype(jnp.int32)  # leading pads per row

@@ -566,3 +566,39 @@ def test_right_padded_mask_rejected():
     bad = np.asarray([[1] * 8, [1, 1, 1, 1, 1, 0, 0, 0]], np.int32)
     with pytest.raises(ValueError, match="left-padded"):
         generate(llama_model, ids, max_new_tokens=2, attention_mask=bad)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "opt", "neox", "mixtral"])
+def test_padded_batch_invisible_all_causal_families(family):
+    """Left-padding must be invisible for every causal plan, not just Llama."""
+    set_seed(11)
+    if family == "gpt2":
+        from accelerate_tpu.models import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        module = GPT2LMHeadModel(cfg)
+    elif family == "opt":
+        from accelerate_tpu.models import OPTConfig, OPTForCausalLM
+
+        cfg = OPTConfig.tiny(dtype=jnp.float32)
+        module = OPTForCausalLM(cfg)
+    elif family == "neox":
+        from accelerate_tpu.models import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        cfg = GPTNeoXConfig.tiny(dtype=jnp.float32)
+        module = GPTNeoXForCausalLM(cfg)
+    else:
+        from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig.tiny(dtype=jnp.float32)
+        module = MixtralForCausalLM(cfg)
+
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, cfg.vocab_size, (1, 4)).astype(np.int32)
+    model = Model.from_flax(module, jax.random.key(0), prompt)
+    alone = generate(model, prompt, max_new_tokens=4)
+
+    padded = np.concatenate([np.zeros((1, 2), np.int32), prompt], axis=1)
+    mask = np.asarray([[0, 0, 1, 1, 1, 1]], np.int32)
+    batched = generate(model, padded, max_new_tokens=4, attention_mask=mask)
+    np.testing.assert_array_equal(np.asarray(batched)[:, 6:], np.asarray(alone)[:, 4:])
