@@ -79,6 +79,7 @@ from .whisper import (
 from .megatron import (
     load_megatron_checkpoint,
     megatron_config_from_args,
+    llama_params_to_megatron_core,
     megatron_core_params_to_llama,
     merge_megatron_tp_shards,
 )

@@ -42,6 +42,7 @@ __all__ = [
     "merge_megatron_tp_shards",
     "megatron_config_from_args",
     "megatron_core_params_to_llama",
+    "llama_params_to_megatron_core",
 ]
 
 
@@ -265,6 +266,67 @@ def megatron_core_params_to_llama(cfg, sd: dict[str, np.ndarray]) -> dict:
     if not cfg.tie_word_embeddings:
         params["lm_head"] = {"kernel": sd["output_layer.weight"].T}
     return params
+
+
+def llama_params_to_megatron_core(cfg, params) -> dict[str, np.ndarray]:
+    """Export native Llama params to the megatron-core flat layout — the
+    inverse of :func:`megatron_core_params_to_llama` (fused per-GQA-group QKV
+    rows q...q k v, SwiGLU gate-then-up fc1 halves, torch ``[out, in]``
+    weights). Round-trip parity is pinned by tests/test_megatron.py."""
+    h, hn = cfg.hidden_size, cfg.head_dim
+    nq, ng = cfg.num_attention_heads, cfg.num_key_value_heads
+    q_per_g = nq // ng
+    if not cfg.scan_layers:
+        raise ValueError("export requires scan_layers=True (stacked blocks)")
+    stacked = params["model"]["layers"]["block"]
+    sd: dict[str, np.ndarray] = {
+        "embedding.word_embeddings.weight": np.asarray(
+            params["model"]["embed_tokens"]["embedding"]
+        ),
+        "decoder.final_layernorm.weight": np.asarray(params["model"]["norm"]["weight"]),
+    }
+    if not cfg.tie_word_embeddings:
+        sd["output_layer.weight"] = np.asarray(params["lm_head"]["kernel"]).T
+    for i in range(cfg.num_hidden_layers):
+        blk = {k: v for k, v in _index_layer(stacked, i).items()}
+        a = blk["self_attn"]
+        q = a["q_proj"]["kernel"].reshape(h, nq * hn).T
+        k = a["k_proj"]["kernel"].reshape(h, ng * hn).T
+        v = a["v_proj"]["kernel"].reshape(h, ng * hn).T
+        groups = []
+        for g in range(ng):
+            groups.append(q[g * q_per_g * hn : (g + 1) * q_per_g * hn])
+            groups.append(k[g * hn : (g + 1) * hn])
+            groups.append(v[g * hn : (g + 1) * hn])
+        p = f"decoder.layers.{i}."
+        sd[p + "self_attention.linear_qkv.weight"] = np.concatenate(groups, axis=0)
+        if "bias" in a["q_proj"]:
+            bq = a["q_proj"]["bias"].reshape(nq * hn)
+            bk = a["k_proj"]["bias"].reshape(ng * hn)
+            bv = a["v_proj"]["bias"].reshape(ng * hn)
+            bg = []
+            for g in range(ng):
+                bg.append(bq[g * q_per_g * hn : (g + 1) * q_per_g * hn])
+                bg.append(bk[g * hn : (g + 1) * hn])
+                bg.append(bv[g * hn : (g + 1) * hn])
+            sd[p + "self_attention.linear_qkv.bias"] = np.concatenate(bg)
+        sd[p + "self_attention.linear_qkv.layer_norm_weight"] = blk["input_layernorm"]["weight"]
+        sd[p + "self_attention.linear_proj.weight"] = (
+            a["o_proj"]["kernel"].reshape(nq * hn, h).T
+        )
+        sd[p + "mlp.linear_fc1.weight"] = np.concatenate(
+            [blk["mlp"]["gate_proj"]["kernel"].T, blk["mlp"]["up_proj"]["kernel"].T], axis=0
+        )
+        sd[p + "mlp.linear_fc1.layer_norm_weight"] = blk["post_attention_layernorm"]["weight"]
+        sd[p + "mlp.linear_fc2.weight"] = blk["mlp"]["down_proj"]["kernel"].T
+    return sd
+
+
+def _index_layer(stacked: dict, i: int) -> dict:
+    """Slice layer ``i`` out of the stacked nn.scan subtree (pure numpy)."""
+    if isinstance(stacked, dict):
+        return {k: _index_layer(v, i) for k, v in stacked.items()}
+    return np.asarray(stacked[i])
 
 
 def _stack(per_layer: list[dict]) -> dict:

@@ -77,6 +77,7 @@ _BY_FEATURE_OK = {
     "tensor_parallel.py": "tp OK",
     "tracking.py": "tracking OK",
     "generation.py": "generation OK",
+    "megatron_import.py": "megatron import OK",
     "pipeline_inference.py": "pipeline inference over",
 }
 

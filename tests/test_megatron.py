@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
 from accelerate_tpu.models.megatron import (
+    llama_params_to_megatron_core,
     megatron_config_from_args,
     megatron_core_params_to_llama,
     merge_megatron_tp_shards,
@@ -30,61 +31,12 @@ def _native_llama(gqa=True, attention_bias=False):
     return cfg, module, params, ids
 
 
-def _to_megatron_sd(cfg, params):
-    """Inverse conversion: native params -> megatron-core flat dict."""
-    h, hn = cfg.hidden_size, cfg.head_dim
-    nq, ng = cfg.num_attention_heads, cfg.num_key_value_heads
-    q_per_g = nq // ng
-    stacked = params["model"]["layers"]["block"]
-    sd = {
-        "embedding.word_embeddings.weight": np.asarray(
-            params["model"]["embed_tokens"]["embedding"]
-        ),
-        "decoder.final_layernorm.weight": np.asarray(params["model"]["norm"]["weight"]),
-        "output_layer.weight": np.asarray(params["lm_head"]["kernel"]).T,
-    }
-    L = cfg.num_hidden_layers
-    for i in range(L):
-        blk = jax.tree.map(lambda x: np.asarray(x[i]), stacked)
-        a = blk["self_attn"]
-        q = a["q_proj"]["kernel"].reshape(h, nq * hn).T   # [nq*hn, h]
-        k = a["k_proj"]["kernel"].reshape(h, ng * hn).T
-        v = a["v_proj"]["kernel"].reshape(h, ng * hn).T
-        groups = []
-        for g in range(ng):
-            groups.append(q[g * q_per_g * hn : (g + 1) * q_per_g * hn])
-            groups.append(k[g * hn : (g + 1) * hn])
-            groups.append(v[g * hn : (g + 1) * hn])
-        p = f"decoder.layers.{i}."
-        sd[p + "self_attention.linear_qkv.weight"] = np.concatenate(groups, axis=0)
-        if "bias" in a["q_proj"]:
-            bq = a["q_proj"]["bias"].reshape(nq * hn)
-            bk = a["k_proj"]["bias"].reshape(ng * hn)
-            bv = a["v_proj"]["bias"].reshape(ng * hn)
-            bg = []
-            for g in range(ng):
-                bg.append(bq[g * q_per_g * hn : (g + 1) * q_per_g * hn])
-                bg.append(bk[g * hn : (g + 1) * hn])
-                bg.append(bv[g * hn : (g + 1) * hn])
-            sd[p + "self_attention.linear_qkv.bias"] = np.concatenate(bg)
-        sd[p + "self_attention.linear_qkv.layer_norm_weight"] = blk["input_layernorm"]["weight"]
-        sd[p + "self_attention.linear_proj.weight"] = (
-            a["o_proj"]["kernel"].reshape(nq * hn, h).T
-        )
-        sd[p + "mlp.linear_fc1.weight"] = np.concatenate(
-            [blk["mlp"]["gate_proj"]["kernel"].T, blk["mlp"]["up_proj"]["kernel"].T], axis=0
-        )
-        sd[p + "mlp.linear_fc1.layer_norm_weight"] = blk["post_attention_layernorm"]["weight"]
-        sd[p + "mlp.linear_fc2.weight"] = blk["mlp"]["down_proj"]["kernel"].T
-    return sd
-
-
 @pytest.mark.parametrize("gqa", [False, True])
 def test_megatron_core_import_logit_parity(gqa):
     cfg, module, params, ids = _native_llama(gqa)
     want = module.apply({"params": params}, ids)
 
-    sd = _to_megatron_sd(cfg, params)
+    sd = llama_params_to_megatron_core(cfg, params)
     got_params = megatron_core_params_to_llama(cfg, sd)
     got = module.apply({"params": jax.tree.map(jnp.asarray, got_params)}, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
@@ -95,7 +47,7 @@ def test_megatron_tp_shard_merge_roundtrip():
     TP shards, merge, convert — parity must survive."""
     cfg, module, params, ids = _native_llama(gqa=False)
     want = module.apply({"params": params}, ids)
-    sd = _to_megatron_sd(cfg, params)
+    sd = llama_params_to_megatron_core(cfg, params)
 
     def split(name, arr):
         if name.endswith("linear_fc1.weight"):
@@ -144,7 +96,7 @@ def test_load_megatron_checkpoint_dir(tmp_path):
     torch = pytest.importorskip("torch")
 
     cfg, module, params, ids = _native_llama(gqa=False)
-    sd = _to_megatron_sd(cfg, params)
+    sd = llama_params_to_megatron_core(cfg, params)
     it_dir = tmp_path / "iter_0000100" / "mp_rank_00"
     it_dir.mkdir(parents=True)
     payload = {
@@ -169,7 +121,7 @@ def test_megatron_qkv_bias_roundtrip():
     """add_qkv_bias checkpoints: fused bias slices into q/k/v biases."""
     cfg, module, params, ids = _native_llama(gqa=True, attention_bias=True)
     want = module.apply({"params": params}, ids)
-    sd = _to_megatron_sd(cfg, params)
+    sd = llama_params_to_megatron_core(cfg, params)
     assert any(k.endswith("linear_qkv.bias") for k in sd)
     got_params = megatron_core_params_to_llama(cfg, sd)
     got = module.apply({"params": jax.tree.map(jnp.asarray, got_params)}, ids)
