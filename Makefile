@@ -10,9 +10,9 @@ PYTEST = $(ENV) python -m pytest -q
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
 # workers never collide — the role of the reference's unique-port trick
-# (test_utils/testing.py:810-820). On single-core boxes the wall-clock lever
-# is the persistent XLA compile cache conftest.py sets up instead
-# (/tmp/accelerate_tpu_test_cache): warm runs skip every repeated compile.
+# (test_utils/testing.py:810-820). Single-core boxes gain nothing from -n;
+# the persistent XLA compile cache was tried for them and reverted (see
+# tests/conftest.py: ring-attention executables SIGABRT on cache replay).
 test:
 	$(PYTEST) -n auto tests/
 

@@ -288,7 +288,7 @@ def llama_params_to_megatron_core(cfg, params) -> dict[str, np.ndarray]:
     if not cfg.tie_word_embeddings:
         sd["output_layer.weight"] = np.asarray(params["lm_head"]["kernel"]).T
     for i in range(cfg.num_hidden_layers):
-        blk = {k: v for k, v in _index_layer(stacked, i).items()}
+        blk = _index_layer(stacked, i)
         a = blk["self_attn"]
         q = a["q_proj"]["kernel"].reshape(h, nq * hn).T
         k = a["k_proj"]["kernel"].reshape(h, ng * hn).T

@@ -20,23 +20,17 @@ if not os.environ.get("ACCELERATE_TEST_USE_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    # Persistent compilation cache: the suite's wall-clock is dominated by
-    # XLA compiles of the same tiny models over and over (across tests AND
-    # across the launched-subprocess gangs). Cache them on disk — second and
-    # later runs skip straight to execution. Guarded: older jaxlibs may not
-    # support caching on the CPU backend.
-    try:
-        cache_dir = os.environ.get("ACCELERATE_TEST_COMPILE_CACHE", "/tmp/accelerate_tpu_test_cache")
+    # Persistent XLA compilation cache: tried (2.6x on warm model-file
+    # reruns) and REVERTED — cache-hit replays of the ring-attention
+    # (shard_map/ppermute) executables SIGABRT the CPU backend, with or
+    # without jax_persistent_cache_enable_xla_caches. Opt in explicitly via
+    # ACCELERATE_TEST_COMPILE_CACHE for suites that skip the cp/ring tests.
+    cache_dir = os.environ.get("ACCELERATE_TEST_COMPILE_CACHE")
+    if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-        # Launched-subprocess gangs don't import this conftest — hand the
-        # cache to them through the env (jax reads these at import).
         os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
         os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-        os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "all")
-    except Exception:
-        pass
 
 import pytest  # noqa: E402
 

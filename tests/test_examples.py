@@ -137,6 +137,7 @@ _FEATURE_MARKERS = {
     "tracking.py": ["init_trackers", "log"],
     "big_model_inference.py": ["dispatch", "device_map"],
     "generation.py": ["generate"],
+    "megatron_import.py": ["load_megatron_checkpoint", "merge_megatron_tp_shards"],
     "pipeline_inference.py": ["prepare_pippy"],
 }
 
