@@ -64,6 +64,7 @@ from .inference import (  # noqa: E402
 )
 from .generation import (  # noqa: E402
     EncDecState,
+    clear_generation_cache,
     GenerationConfig,
     KVCache,
     beam_search,

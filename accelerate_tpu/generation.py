@@ -719,29 +719,44 @@ class GenerationConfig:
     pad_token_id: Optional[int] = None  # finished rows get this (default: eos)
 
 
-def _resolve_encdec(model, inputs, decoder_input_ids, beams: int = 1):
-    """If ``model`` is an encoder-decoder family, run its encoder and return
-    ``(decoder_ids, fwd)`` where ``fwd`` has the causal plans' signature with
-    the encoded state closed over. Otherwise ``(None, None)``.
+_ENCODE_JIT_CACHE: dict = {}
 
-    ``beams > 1``: ``fwd`` dispatches on the batch dim — prefill sees B rows,
-    decode sees B*beams — selecting the plain or beam-tiled encoded state.
-    """
+
+def _resolve_encdec_state(model, inputs, decoder_input_ids):
+    """If ``model`` is an encoder-decoder family, run its encoder (memoized
+    jit per (encode_fn, cfg) — not per call) and return
+    ``(decoder_ids, decode_fn, enc_state)``; else ``(None, None, None)``."""
     name = type(model.module).__name__
     plan = ENCDEC_GENERATION_PLANS.get(name)
     if plan is None:
-        return None, None
+        return None, None, None
     encode_fn, decode_fn = plan
     cfg = model.module.config
     if not getattr(cfg, "scan_layers", True):
         # Same early diagnostic as the decode fns — the encoders also slice
         # the stacked (scan) layer layout for the cross K/V.
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
-    enc_state = jax.jit(partial(encode_fn, cfg))(model.params, inputs)
+    key = (encode_fn, cfg)
+    if key not in _ENCODE_JIT_CACHE:
+        _ENCODE_JIT_CACHE[key] = jax.jit(partial(encode_fn, cfg))
+    enc_state = _ENCODE_JIT_CACHE[key](model.params, inputs)
     if decoder_input_ids is None:
         b = jnp.asarray(inputs).shape[0]
         start_id = getattr(cfg, "decoder_start_token_id", 0)
         decoder_input_ids = jnp.full((b, 1), start_id, jnp.int32)
+    return jnp.asarray(decoder_input_ids), decode_fn, enc_state
+
+
+def _resolve_encdec(model, inputs, decoder_input_ids, beams: int = 1):
+    """Closure variant of :func:`_resolve_encdec_state` (beam search):
+    returns ``(decoder_ids, fwd)`` with the encoded state closed over.
+
+    ``beams > 1``: ``fwd`` dispatches on the batch dim — prefill sees B rows,
+    decode sees B*beams — selecting the plain or beam-tiled encoded state.
+    """
+    dec_ids, decode_fn, enc_state = _resolve_encdec_state(model, inputs, decoder_input_ids)
+    if decode_fn is None:
+        return None, None
     states = {enc_state.cross_k.shape[1]: enc_state}
     if beams > 1:
         tiled = EncDecState(
@@ -754,7 +769,7 @@ def _resolve_encdec(model, inputs, decoder_input_ids, beams: int = 1):
     def fwd(cfg, params, ids, cache, return_all=False):
         return decode_fn(cfg, params, ids, cache, states[ids.shape[0]], return_all)
 
-    return jnp.asarray(decoder_input_ids), fwd
+    return dec_ids, fwd
 
 
 def generate(
@@ -779,10 +794,12 @@ def generate(
     rows shorter than S carry leading pads marked 0. RoPE positions shift
     per row so content starts at 0 and pad slots never enter attention.
 
-    One jitted prefill + one jitted decode step (compiled once, reused every
-    token). Returns (B, S + max_new_tokens); after a row emits
-    ``eos_token_id`` it is padded with ``pad_token_id`` (defaulting to the
-    EOS id, like transformers' warning-fallback).
+    Execution: ONE jitted program (prefill + the full decode ``lax.scan``),
+    memoized per (plan, config, sampling settings) — repeated calls reuse
+    the compiled loop (see :func:`_generation_loop` /
+    :func:`clear_generation_cache`). Returns (B, S + max_new_tokens); after
+    a row emits ``eos_token_id`` it is padded with ``pad_token_id``
+    (defaulting to the EOS id, like transformers' warning-fallback).
 
     Encoder-decoder families (T5, Whisper): ``input_ids`` is the ENCODER
     input (token ids / mel features), the encoder runs once, and the decode
@@ -803,14 +820,17 @@ def generate(
     params = model.params
     # An explicit forward_cached override outranks the registries, exactly as
     # on the causal path.
-    dec_ids, encdec_fwd = (
-        (None, None) if forward_cached is not None
-        else _resolve_encdec(model, input_ids, decoder_input_ids)
-    )
-    if encdec_fwd is not None:
-        input_ids, fwd = dec_ids, encdec_fwd
+    enc_state = None
+    if forward_cached is not None:
+        fwd = forward_cached
     else:
-        fwd = forward_cached or GENERATION_PLANS.get(type(model.module).__name__)
+        dec_ids, decode_fn, enc_state = _resolve_encdec_state(
+            model, input_ids, decoder_input_ids
+        )
+        if decode_fn is not None:
+            input_ids, fwd = dec_ids, decode_fn
+        else:
+            fwd = GENERATION_PLANS.get(type(model.module).__name__)
     if fwd is None:
         known = ", ".join(sorted(GENERATION_PLANS) + sorted(ENCDEC_GENERATION_PLANS))
         raise ValueError(
@@ -826,6 +846,7 @@ def generate(
         )
     rng = rng if rng is not None else jax.random.key(0)
 
+    pad_offset = kv_valid = None
     if attention_mask is not None:
         import inspect
 
@@ -850,35 +871,73 @@ def generate(
         kv_valid = jnp.concatenate(
             [mask.astype(bool), jnp.ones((b, t_max - s), bool)], axis=1
         )
-        base_fwd = fwd
 
-        def fwd(cfg, params, ids, cache, return_all=False):
-            return base_fwd(
-                cfg, params, ids, cache, return_all,
-                pad_offset=pad_offset, kv_valid=kv_valid,
-            )
-
+    loop = _generation_loop(
+        fwd, cfg, max_new_tokens, temperature, top_k, top_p,
+        eos_token_id, pad_token_id,
+        masked=attention_mask is not None, encdec=enc_state is not None,
+    )
     cache = init_cache(cfg, b, t_max)
-    prefill = jax.jit(partial(fwd, cfg))
-    logits, cache = prefill(params, input_ids, cache)
+    toks = loop(params, input_ids, cache, rng, pad_offset, kv_valid, enc_state)
+    return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)
+
+
+_GEN_LOOP_CACHE: dict = {}
+_GEN_LOOP_CACHE_MAX = 32  # FIFO-evicted: callers varying settings per call
+                          # (fresh closures, per-request max_new_tokens)
+                          # must not grow compiled programs without bound.
+
+
+def clear_generation_cache() -> None:
+    """Drop all memoized generation loops (and their compiled executables)."""
+    _GEN_LOOP_CACHE.clear()
+
+
+def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
+                     eos_token_id, pad_token_id, *, masked: bool, encdec: bool):
+    """ONE jitted program per (plan, config, sampling settings): prefill +
+    the whole decode ``lax.scan``. Memoized — repeated ``generate`` calls
+    with the same settings reuse the compiled loop instead of re-tracing it
+    (closures used to defeat jit's cache, costing a full recompile per call).
+    Dynamic data (params, ids, cache, rng, pad/enc state) flows as arguments.
+    """
+    key = (fwd, cfg, max_new_tokens, temperature, top_k, top_p,
+           eos_token_id, pad_token_id, masked, encdec)
+    cached = _GEN_LOOP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    while len(_GEN_LOOP_CACHE) >= _GEN_LOOP_CACHE_MAX:
+        _GEN_LOOP_CACHE.pop(next(iter(_GEN_LOOP_CACHE)))
 
     sample = partial(sample_logits, temperature=temperature, top_k=top_k, top_p=top_p)
 
-    def step(carry, _):
-        cache, logits, rng, done = carry
-        rng, sub = jax.random.split(rng)
-        tok = sample(logits, sub)
-        if eos_token_id is not None:
-            tok = jnp.where(done, pad_token_id, tok)
-            done = done | (tok == eos_token_id)
-        logits, cache = fwd(cfg, params, tok[:, None], cache)
-        return (cache, logits, rng, done), tok
+    def run(params, input_ids, cache, rng, pad_offset, kv_valid, enc_state):
+        def call(ids, cache):
+            args = (enc_state,) if encdec else ()
+            kwargs = dict(pad_offset=pad_offset, kv_valid=kv_valid) if masked else {}
+            return fwd(cfg, params, ids, cache, *args, **kwargs)
 
-    done0 = jnp.zeros((b,), bool)
-    (_, _, _, _), toks = jax.lax.scan(
-        step, (cache, logits, rng, done0), None, length=max_new_tokens
-    )
-    return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)
+        logits, cache = call(input_ids, cache)
+
+        def step(carry, _):
+            cache, logits, rng, done = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample(logits, sub)
+            if eos_token_id is not None:
+                tok = jnp.where(done, pad_token_id, tok)
+                done = done | (tok == eos_token_id)
+            logits, cache = call(tok[:, None], cache)
+            return (cache, logits, rng, done), tok
+
+        done0 = jnp.zeros((input_ids.shape[0],), bool)
+        (_, _, _, _), toks = jax.lax.scan(
+            step, (cache, logits, rng, done0), None, length=max_new_tokens
+        )
+        return toks
+
+    jitted = jax.jit(run)
+    _GEN_LOOP_CACHE[key] = jitted
+    return jitted
 
 
 def speculative_generate(

@@ -602,3 +602,20 @@ def test_padded_batch_invisible_all_causal_families(family):
     mask = np.asarray([[0, 0, 1, 1, 1, 1]], np.int32)
     batched = generate(model, padded, max_new_tokens=4, attention_mask=mask)
     np.testing.assert_array_equal(np.asarray(batched)[:, 6:], np.asarray(alone)[:, 4:])
+
+
+def test_generate_reuses_compiled_loop(llama):
+    """Repeated generate() calls with identical settings must reuse ONE
+    compiled loop (closures used to defeat jit's cache — a full recompile
+    per call)."""
+    from accelerate_tpu import generation as G
+
+    cfg, module, model, ids = llama
+    G._GEN_LOOP_CACHE.clear()
+    a = generate(model, ids, max_new_tokens=3)
+    assert len(G._GEN_LOOP_CACHE) == 1
+    b = generate(model, ids, max_new_tokens=3)
+    assert len(G._GEN_LOOP_CACHE) == 1  # same key -> same compiled loop
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    generate(model, ids, max_new_tokens=4)  # different settings -> new entry
+    assert len(G._GEN_LOOP_CACHE) == 2
