@@ -104,6 +104,18 @@ def _is_schedule(obj) -> bool:
     return callable(obj) and not _is_optax_tx(obj) and not isinstance(obj, Model) and not _is_dataloader_like(obj)
 
 
+class _HookHandle:
+    """Removable registration handle (torch's RemovableHandle contract)."""
+
+    def __init__(self, registry: list, hook):
+        self._registry = registry
+        self._hook = hook
+
+    def remove(self):
+        if self._hook in self._registry:
+            self._registry.remove(self._hook)
+
+
 class Accelerator:
     def __init__(
         self,
@@ -179,6 +191,8 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[BaseDataLoader] = []
         self._custom_objects: list = []
+        self._save_state_pre_hooks: list[Callable] = []
+        self._load_state_pre_hooks: list[Callable] = []
 
         self._train_state: Optional[TrainState] = None
         self._state_shardings = None
@@ -277,6 +291,72 @@ class Accelerator:
     @property
     def sync_gradients(self) -> bool:
         return self.gradient_state.sync_gradients
+
+    # -- mesh-axis rank properties (reference: accelerator.py ParallelismConfig
+    # rank accessors; here a rank is the device's coordinate on the mesh axis,
+    # derived from process_index over the process-contiguous axis order) -----
+
+    def _axis_rank(self, axis: str) -> int:
+        mesh = self.mesh
+        if mesh is None or axis not in mesh.shape or mesh.shape[axis] == 1:
+            return 0
+        # Read this process's coordinate off the mesh itself: device order may
+        # be ICI-optimized (mesh_utils.create_device_mesh), so arithmetic on
+        # process_index would lie on multi-host meshes.
+        dev = jax.local_devices()[0]
+        coords = np.argwhere(mesh.devices == dev)
+        if coords.size == 0:
+            return 0
+        axis_idx = list(mesh.shape.keys()).index(axis)
+        return int(coords[0][axis_idx])
+
+    @property
+    def data_parallel_rank(self) -> int:
+        return self._axis_rank("dp_replicate")
+
+    @property
+    def data_parallel_shard_rank(self) -> int:
+        return self._axis_rank("dp_shard")
+
+    @property
+    def context_parallel_rank(self) -> int:
+        return self._axis_rank("cp")
+
+    @property
+    def tensor_parallel_rank(self) -> int:
+        return self._axis_rank("tp")
+
+    @property
+    def pipeline_parallel_rank(self) -> int:
+        return self._axis_rank("pp")
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:
+        """True if the last optimizer step was skipped (fp16 overflow) —
+        reference: accelerator.py GradScaler bookkeeping; here the fused step
+        freezes params on non-finite grads and the wrapped optimizer records
+        it."""
+        return any(opt.step_was_skipped for opt in self._optimizers)
+
+    # -- dataloader-config passthroughs (reference exposes these directly;
+    # split_batches is already a ctor-set attribute) ---
+
+    @property
+    def dispatch_batches(self):
+        return self.dataloader_config.dispatch_batches
+
+    @property
+    def even_batches(self) -> bool:
+        return self.dataloader_config.even_batches
+
+    @property
+    def use_seedable_sampler(self) -> bool:
+        return self.dataloader_config.use_seedable_sampler
+
+    @property
+    def non_blocking(self) -> bool:
+        """Parity shim: device transfers are async by construction in JAX."""
+        return True
 
     @property
     def project_dir(self):
@@ -1081,15 +1161,49 @@ class Accelerator:
             )
         self._custom_objects.extend(objects)
 
-    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, **save_model_func_kwargs):
-        from .checkpointing import save_accelerator_state
+    def register_save_state_pre_hook(self, hook: Callable):
+        """``hook(models, weights, output_dir)`` runs before every
+        ``save_state`` write (reference: accelerator.py:3856-3890). Here the
+        hook receives ``(prepared_models, train_state, output_dir)``. Returns
+        a removable handle (``.remove()``)."""
+        self._save_state_pre_hooks.append(hook)
+        return _HookHandle(self._save_state_pre_hooks, hook)
 
+    def register_load_state_pre_hook(self, hook: Callable):
+        """``hook(models, input_dir)`` runs before every ``load_state``
+        restore (reference: accelerator.py:3892-3923)."""
+        self._load_state_pre_hooks.append(hook)
+        return _HookHandle(self._load_state_pre_hooks, hook)
+
+    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, **save_model_func_kwargs):
+        from .checkpointing import _checkpoint_dir, save_accelerator_state
+
+        if self._save_state_pre_hooks:
+            # Hooks see the RESOLVED target (automatic_checkpoint_naming makes
+            # the raw arg None) so sidecar writers land next to the checkpoint.
+            resolved = _checkpoint_dir(self, output_dir)
+            for hook in self._save_state_pre_hooks:
+                hook(self._models, self._train_state, resolved)
+            output_dir = resolved
         return save_accelerator_state(self, output_dir, safe_serialization=safe_serialization)
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
-        from .checkpointing import load_accelerator_state
+        from .checkpointing import _checkpoint_dir, load_accelerator_state
 
+        if self._load_state_pre_hooks:
+            resolved = _checkpoint_dir(self, input_dir, for_load=True)
+            for hook in self._load_state_pre_hooks:
+                hook(self._models, resolved)
+            input_dir = resolved
         return load_accelerator_state(self, input_dir)
+
+    def unscale_gradients(self, optimizer=None):
+        """Parity advisory (reference: accelerator.py:2928-2944 unscales the
+        GradScaler before manual grad inspection): fp16 loss-scale handling
+        here is fused into the step — grads exposed via ``optimizer.grads`` /
+        ``train_state.accum_grads`` are ALREADY unscaled, so there is nothing
+        to do. Kept so migrating call sites run unchanged."""
+        return None
 
     def save_model(
         self,

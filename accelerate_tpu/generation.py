@@ -738,6 +738,8 @@ def _resolve_encdec_state(model, inputs, decoder_input_ids):
         raise ValueError("generation requires scan_layers=True (stacked blocks)")
     key = (encode_fn, cfg)
     if key not in _ENCODE_JIT_CACHE:
+        while len(_ENCODE_JIT_CACHE) >= _GEN_LOOP_CACHE_MAX:
+            _ENCODE_JIT_CACHE.pop(next(iter(_ENCODE_JIT_CACHE)))
         _ENCODE_JIT_CACHE[key] = jax.jit(partial(encode_fn, cfg))
     enc_state = _ENCODE_JIT_CACHE[key](model.params, inputs)
     if decoder_input_ids is None:
@@ -889,8 +891,10 @@ _GEN_LOOP_CACHE_MAX = 32  # FIFO-evicted: callers varying settings per call
 
 
 def clear_generation_cache() -> None:
-    """Drop all memoized generation loops (and their compiled executables)."""
+    """Drop all memoized generation loops AND encoder jits (and their
+    compiled executables)."""
     _GEN_LOOP_CACHE.clear()
+    _ENCODE_JIT_CACHE.clear()
 
 
 def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,

@@ -79,3 +79,55 @@ def test_gradient_state_accumulation_flags():
     with acc.accumulate():
         second = acc.sync_gradients
     assert (first, second) == (False, True)
+
+
+def test_axis_rank_properties_single_process():
+    """Rank accessors: single process is rank 0 on every axis; the accessors
+    exist and agree with the mesh shape (reference parity surface)."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2))
+    assert acc.data_parallel_rank == 0
+    assert acc.data_parallel_shard_rank == 0
+    assert acc.tensor_parallel_rank == 0
+    assert acc.pipeline_parallel_rank == 0
+    assert acc.context_parallel_rank == 0
+    assert acc.split_batches in (True, False)
+    assert acc.even_batches in (True, False)
+    assert acc.non_blocking is True
+    assert acc.optimizer_step_was_skipped is False
+    assert acc.unscale_gradients() is None
+
+
+def test_save_load_state_pre_hooks(tmp_path):
+    import optax
+    import flax.linen as nn
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    x = np.ones((2, 4), np.float32)
+    acc = Accelerator()
+    model = Model.from_flax(M(), jax.random.key(0), x)
+    acc.prepare(model, optax.sgd(1e-2))
+
+    calls = []
+    h1 = acc.register_save_state_pre_hook(lambda models, state, out: calls.append(("save", out)))
+    h2 = acc.register_load_state_pre_hook(lambda models, inp: calls.append(("load", inp)))
+    out = acc.save_state(str(tmp_path / "ck"))
+    acc.load_state(out)
+    assert [c[0] for c in calls] == ["save", "load"]
+    h1.remove(); h2.remove()
+    acc.save_state(str(tmp_path / "ck2"))
+    assert len(calls) == 2  # removed hooks don't fire
