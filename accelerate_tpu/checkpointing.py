@@ -105,7 +105,7 @@ def _save_host_side_state(accelerator, state, output_dir: str) -> None:
         pickle.dump(rng_state(), f)
 
 
-_ORBAX_DIR = "distributed_state"
+from .utils.constants import ORBAX_DIR_NAME as _ORBAX_DIR  # shared with utils/fsdp_utils.py
 
 
 def _orbax_payload(state) -> dict:

@@ -1,46 +1,37 @@
-"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint.
+"""`accelerate-tpu merge-weights` — consolidate a checkpoint into portable
+safetensors.
 
 Reference analog: commands/merge.py + utils/fsdp_utils.py:338-420
-(`merge_fsdp_weights`: torch DCP shards → one safetensors). Our `save_state`
-already writes name-keyed sharded safetensors (checkpointing.py); this command
-merges them into a single file (or re-shards at a different max size) so the
-result loads anywhere, including outside the framework.
+(`merge_fsdp_weights`: torch DCP shards → one safetensors). Handles BOTH
+save_state formats: name-keyed sharded safetensors join directly, and
+orbax/TensorStore `distributed_state` dirs restore host-side (params only) —
+the result loads anywhere, including outside the framework. Thin CLI over
+utils/fsdp_utils.merge_fsdp_weights.
 """
 
 from __future__ import annotations
 
 import argparse
-import os
 
 from ..utils.constants import MODEL_NAME
-from ..utils.other import load_sharded_safetensors, save_safetensors, save_sharded_safetensors
+from ..utils.fsdp_utils import merge_fsdp_weights
 
 
 def merge_command(args: argparse.Namespace) -> int:
-    in_dir = args.checkpoint_dir
-    weights_name = args.weights_name or f"{MODEL_NAME}.safetensors"
-    flat = load_sharded_safetensors(in_dir, weights_name=weights_name)
-    if not flat:
-        raise FileNotFoundError(f"No {weights_name} shards found in {in_dir}")
-    os.makedirs(args.output_dir, exist_ok=True)
-    out_name = args.output_name or weights_name
-    if args.max_shard_size:
-        save_sharded_safetensors(
-            flat, args.output_dir, weights_name=out_name, max_shard_size=args.max_shard_size
-        )
-    else:
-        save_safetensors(flat, os.path.join(args.output_dir, out_name))
-    n_params = sum(int(v.size) for v in flat.values())
-    print(
-        f"Merged {len(flat)} tensors ({n_params / 1e6:.1f}M params) from {in_dir} "
-        f"into {args.output_dir}/{out_name}"
+    out = merge_fsdp_weights(
+        args.checkpoint_dir,
+        args.output_dir,
+        weights_name=args.weights_name,
+        output_name=args.output_name,
+        max_shard_size=args.max_shard_size,
     )
+    print(f"Merged weights from {args.checkpoint_dir} into {out}")
     return 0
 
 
 def add_parser(subparsers) -> argparse.ArgumentParser:
     p = subparsers.add_parser(
-        "merge-weights", help="Merge a sharded safetensors checkpoint into one file"
+        "merge-weights", help="Merge a sharded/distributed checkpoint into portable safetensors"
     )
     p.add_argument("checkpoint_dir", help="Directory written by save_state/save_model")
     p.add_argument("output_dir")

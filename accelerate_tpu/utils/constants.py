@@ -6,6 +6,7 @@ gates, instead checkpoint file layout names and env-var prefixes.
 """
 
 MODEL_NAME = "model"
+ORBAX_DIR_NAME = "distributed_state"  # DISTRIBUTED_STATE_DICT checkpoint subdir
 OPTIMIZER_NAME = "optimizer"
 SCHEDULER_NAME = "scheduler"
 SAMPLER_NAME = "sampler"

@@ -69,6 +69,23 @@ class ComputeEnvironment(BaseEnum):
     TPU_POD = "TPU_POD"
 
 
+class LoggerType(BaseEnum):
+    """Tracker identifiers accepted by ``Accelerator(log_with=...)``
+    (reference: utils/dataclasses.py LoggerType). Plain strings work too —
+    ``filter_trackers`` (tracking.py) resolves either."""
+
+    ALL = "all"
+    TENSORBOARD = "tensorboard"
+    WANDB = "wandb"
+    MLFLOW = "mlflow"
+    COMETML = "comet_ml"
+    AIM = "aim"
+    CLEARML = "clearml"
+    DVCLIVE = "dvclive"
+    SWANLAB = "swanlab"
+    TRACKIO = "trackio"
+
+
 class SaveFormat(BaseEnum):
     SAFETENSORS = "safetensors"
     ORBAX = "orbax"

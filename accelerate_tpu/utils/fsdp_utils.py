@@ -1,0 +1,88 @@
+"""Checkpoint consolidation utilities.
+
+Reference analog: utils/fsdp_utils.py:338-420 ``merge_fsdp_weights`` (torch
+DCP shard dirs -> one safetensors). Both of this framework's checkpoint
+formats consolidate here:
+
+- the gathered format is already name-keyed sharded safetensors — merging is
+  a shard-join;
+- the DISTRIBUTED_STATE_DICT format (orbax/TensorStore ``distributed_state``
+  dirs) restores params host-side (no mesh needed) and writes safetensors.
+
+The ``accelerate-tpu merge-weights`` CLI wraps the same function.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .constants import MODEL_NAME, ORBAX_DIR_NAME
+from .other import flatten_state_dict, load_sharded_safetensors, save_safetensors, save_sharded_safetensors
+
+__all__ = ["merge_fsdp_weights"]
+
+
+def _load_distributed_params(ckpt_dir: str) -> dict:
+    """Host-side restore of ONLY the params subtree of an orbax checkpoint —
+    no mesh, no shardings. Partial restore matters: the checkpoint also holds
+    optimizer state (Adam: 2-3x the param bytes) that a merge must not
+    materialize. Falls back to a full restore if this orbax version lacks
+    partial_restore."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(ckpt_dir, ORBAX_DIR_NAME))
+    try:
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+            meta = ckptr.metadata(path)
+            abstract = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), meta.tree["params"]
+            )
+            payload = ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(item={"params": abstract}, partial_restore=True)
+            )
+        params = payload["params"]
+    except Exception:
+        with ocp.StandardCheckpointer() as ckptr:
+            payload = ckptr.restore(path)
+        params = payload.get("params", payload)
+    return {k: np.asarray(v) for k, v in flatten_state_dict(params).items()}
+
+
+def merge_fsdp_weights(
+    checkpoint_dir: str,
+    output_dir: str,
+    *,
+    weights_name: Optional[str] = None,
+    output_name: Optional[str] = None,
+    max_shard_size: Optional[str] = None,
+) -> str:
+    """Consolidate a ``save_state`` checkpoint into portable safetensors.
+
+    Handles both formats: a ``distributed_state`` (orbax) dir restores
+    host-side; sharded safetensors join. ``max_shard_size`` re-shards the
+    output (e.g. ``"5GB"``) instead of writing one file. Returns the output
+    path (file, or directory when re-sharded).
+    """
+    weights_name = weights_name or f"{MODEL_NAME}.safetensors"
+    if os.path.isdir(os.path.join(checkpoint_dir, ORBAX_DIR_NAME)):
+        flat = _load_distributed_params(checkpoint_dir)
+    else:
+        flat = load_sharded_safetensors(checkpoint_dir, weights_name=weights_name)
+    if not flat:
+        raise FileNotFoundError(
+            f"No {weights_name} shards or {ORBAX_DIR_NAME} dir found in {checkpoint_dir}"
+        )
+    os.makedirs(output_dir, exist_ok=True)
+    out_name = output_name or weights_name
+    if max_shard_size:
+        save_sharded_safetensors(
+            flat, output_dir, weights_name=out_name, max_shard_size=max_shard_size
+        )
+        return output_dir
+    out_path = os.path.join(output_dir, out_name)
+    save_safetensors(flat, out_path)
+    return out_path

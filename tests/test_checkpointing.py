@@ -405,3 +405,40 @@ def test_orbax_reshard_on_load_matches_uninterrupted(tmp_path, target_pc, target
         tmp_path, pcs[target_pc], loss_factory, 0, 2, load_dir=ckpt
     )
     np.testing.assert_allclose(losses_resumed, losses_full[2:], rtol=2e-4)
+
+
+def test_merge_fsdp_weights_both_formats(tmp_path):
+    """merge_fsdp_weights consolidates BOTH checkpoint formats into portable
+    safetensors (reference: utils/fsdp_utils.py:338-420)."""
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, merge_fsdp_weights, set_seed
+    from accelerate_tpu.utils.other import flatten_state_dict, load_safetensors
+
+    for fmt in ("SHARDED_STATE_DICT", "DISTRIBUTED_STATE_DICT"):
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        set_seed(0)
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        module = LlamaForCausalLM(cfg)
+        ids = np.arange(4 * 8, dtype=np.int32).reshape(4, 8) % cfg.vocab_size
+        acc = Accelerator(
+            fsdp_plugin=FullyShardedDataParallelPlugin(state_dict_type=fmt),
+        )
+        model = Model.from_flax(module, jax.random.key(0), ids)
+        model, _ = acc.prepare(model, optax.sgd(1e-2))
+        ck = tmp_path / f"ck_{fmt}"
+        acc.save_state(str(ck))
+
+        out = merge_fsdp_weights(str(ck), str(tmp_path / f"merged_{fmt}"))
+        flat = load_safetensors(out)
+        want = {k: np.asarray(v) for k, v in
+                flatten_state_dict(acc.train_state.params).items()}
+        assert set(flat) == set(want)
+        for k in want:
+            np.testing.assert_allclose(flat[k], want[k], rtol=1e-6)
