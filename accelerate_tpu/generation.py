@@ -717,6 +717,12 @@ class GenerationConfig:
     top_p: Optional[float] = None
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None  # finished rows get this (default: eos)
+    # Logit processors (transformers semantics — Whisper's transcription UX):
+    suppress_tokens: Optional[tuple] = None        # never sampled
+    begin_suppress_tokens: Optional[tuple] = None  # not at the FIRST new token
+    forced_decoder_ids: Optional[tuple] = None     # ((position, token), ...) —
+    # absolute decoder positions (0 = decoder start), like HF Whisper's
+    # [(1, lang), (2, task), (3, notimestamps)]
 
 
 _ENCODE_JIT_CACHE: dict = {}
@@ -789,6 +795,9 @@ def generate(
     config: Optional[GenerationConfig] = None,
     decoder_input_ids=None,
     attention_mask=None,
+    suppress_tokens=None,
+    begin_suppress_tokens=None,
+    forced_decoder_ids=None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for ``input_ids`` (B, S).
 
@@ -818,6 +827,14 @@ def generate(
     pad_token_id = pad_token_id if pad_token_id is not None else gc.pad_token_id
     if pad_token_id is None:
         pad_token_id = eos_token_id
+    suppress_tokens = suppress_tokens if suppress_tokens is not None else gc.suppress_tokens
+    begin_suppress_tokens = (
+        begin_suppress_tokens if begin_suppress_tokens is not None
+        else gc.begin_suppress_tokens
+    )
+    forced_decoder_ids = (
+        forced_decoder_ids if forced_decoder_ids is not None else gc.forced_decoder_ids
+    )
     cfg = model.module.config
     params = model.params
     # An explicit forward_cached override outranks the registries, exactly as
@@ -878,6 +895,10 @@ def generate(
         fwd, cfg, max_new_tokens, temperature, top_k, top_p,
         eos_token_id, pad_token_id,
         masked=attention_mask is not None, encdec=enc_state is not None,
+        suppress=tuple(suppress_tokens) if suppress_tokens else None,
+        begin_suppress=tuple(begin_suppress_tokens) if begin_suppress_tokens else None,
+        forced=tuple(tuple(f) for f in forced_decoder_ids) if forced_decoder_ids else None,
+        prompt_len=s,
     )
     cache = init_cache(cfg, b, t_max)
     toks = loop(params, input_ids, cache, rng, pad_offset, kv_valid, enc_state)
@@ -898,15 +919,24 @@ def clear_generation_cache() -> None:
 
 
 def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
-                     eos_token_id, pad_token_id, *, masked: bool, encdec: bool):
+                     eos_token_id, pad_token_id, *, masked: bool, encdec: bool,
+                     suppress=None, begin_suppress=None, forced=None,
+                     prompt_len: int = 0):
     """ONE jitted program per (plan, config, sampling settings): prefill +
     the whole decode ``lax.scan``. Memoized — repeated ``generate`` calls
     with the same settings reuse the compiled loop instead of re-tracing it
     (closures used to defeat jit's cache, costing a full recompile per call).
     Dynamic data (params, ids, cache, rng, pad/enc state) flows as arguments.
+
+    Logit processors (transformers semantics): ``suppress`` masks tokens at
+    every step; ``begin_suppress`` only at the first generated position;
+    ``forced`` is ((abs_decoder_position, token), ...) — positions before
+    ``prompt_len`` are already in the prompt and ignored.
     """
+    forced_key = (forced, prompt_len) if forced else None
     key = (fwd, cfg, max_new_tokens, temperature, top_k, top_p,
-           eos_token_id, pad_token_id, masked, encdec)
+           eos_token_id, pad_token_id, masked, encdec,
+           suppress, begin_suppress, forced_key)
     cached = _GEN_LOOP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -914,6 +944,14 @@ def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
         _GEN_LOOP_CACHE.pop(next(iter(_GEN_LOOP_CACHE)))
 
     sample = partial(sample_logits, temperature=temperature, top_k=top_k, top_p=top_p)
+    neg_inf = float(np.finfo(np.float32).min)
+    forced_map = None
+    if forced:
+        fm = np.full((max_new_tokens,), -1, np.int32)
+        for pos, tok in forced:
+            if prompt_len <= pos < prompt_len + max_new_tokens:
+                fm[pos - prompt_len] = tok
+        forced_map = jnp.asarray(fm)
 
     def run(params, input_ids, cache, rng, pad_offset, kv_valid, enc_state):
         def call(ids, cache):
@@ -922,11 +960,21 @@ def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
             return fwd(cfg, params, ids, cache, *args, **kwargs)
 
         logits, cache = call(input_ids, cache)
+        if begin_suppress:
+            # Only the FIRST sampled token sees these (transformers
+            # begin_suppress_tokens) — and its logits are exactly the prefill
+            # output, so mask once here instead of conditionally every step.
+            logits = logits.at[:, list(begin_suppress)].set(neg_inf)
 
-        def step(carry, _):
+        def step(carry, t):
             cache, logits, rng, done = carry
             rng, sub = jax.random.split(rng)
+            if suppress:
+                logits = logits.at[:, list(suppress)].set(neg_inf)
             tok = sample(logits, sub)
+            if forced_map is not None:
+                f = forced_map[t]
+                tok = jnp.where(f >= 0, f, tok)
             if eos_token_id is not None:
                 tok = jnp.where(done, pad_token_id, tok)
                 done = done | (tok == eos_token_id)
@@ -935,7 +983,7 @@ def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
 
         done0 = jnp.zeros((input_ids.shape[0],), bool)
         (_, _, _, _), toks = jax.lax.scan(
-            step, (cache, logits, rng, done0), None, length=max_new_tokens
+            step, (cache, logits, rng, done0), jnp.arange(max_new_tokens)
         )
         return toks
 

@@ -619,3 +619,60 @@ def test_generate_reuses_compiled_loop(llama):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     generate(model, ids, max_new_tokens=4)  # different settings -> new entry
     assert len(G._GEN_LOOP_CACHE) == 2
+
+
+def test_suppress_tokens_matches_transformers():
+    """suppress_tokens / begin_suppress_tokens: greedy parity with HF."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from accelerate_tpu.models import model_from_pretrained
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    hf.eval()
+    ids = np.random.default_rng(3).integers(0, 96, (1, 6)).astype(np.int64)
+    # Suppress whatever unconstrained greedy picks first, to force divergence.
+    with torch.no_grad():
+        free = hf.generate(torch.from_numpy(ids), max_new_tokens=1, do_sample=False,
+                           pad_token_id=0).numpy()
+    banned = int(free[0, -1])
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, do_sample=False, pad_token_id=0,
+            suppress_tokens=[banned], begin_suppress_tokens=[(banned + 1) % 96],
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(
+        ours, ids.astype(np.int32), max_new_tokens=5,
+        suppress_tokens=(banned,), begin_suppress_tokens=((banned + 1) % 96,),
+    )
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
+    assert banned not in np.asarray(got)[0, 6:]
+
+
+def test_forced_decoder_ids_whisper_style():
+    """forced_decoder_ids pin tokens at absolute decoder positions (HF
+    Whisper's [(1, lang), (2, task)] convention); the rest decode greedily."""
+    model, cfg, feats = _tiny_whisper()
+    prompt = np.asarray([[7], [7]], np.int32)  # decoder position 0
+    forced = ((1, 40), (2, 41))
+    got = generate(
+        model, feats, max_new_tokens=5, decoder_input_ids=prompt,
+        forced_decoder_ids=forced,
+    )
+    out = np.asarray(got)
+    assert (out[:, 1] == 40).all() and (out[:, 2] == 41).all()
+
+    # Positions 3+ must continue greedily FROM the forced prefix: the tail
+    # equals unforced greedy decoding seeded with [7, 40, 41].
+    seeded = generate(
+        model, feats, max_new_tokens=3,
+        decoder_input_ids=np.asarray([[7, 40, 41], [7, 40, 41]], np.int32),
+    )
+    np.testing.assert_array_equal(out[:, 3:], np.asarray(seeded)[:, 3:])
