@@ -911,11 +911,27 @@ _GEN_LOOP_CACHE_MAX = 32  # FIFO-evicted: callers varying settings per call
                           # must not grow compiled programs without bound.
 
 
+_PLAN_JIT_CACHE: dict = {}
+
+
+def _plan_jit(fwd, cfg):
+    """Memoized ``jax.jit(partial(fwd, cfg))`` keyed by (fwd, cfg) — lets
+    beam_search reuse compiled prefill/decode across calls (registry plans
+    are stable keys; per-call enc-dec closures still rebuild)."""
+    key = (fwd, cfg)
+    if key not in _PLAN_JIT_CACHE:
+        while len(_PLAN_JIT_CACHE) >= _GEN_LOOP_CACHE_MAX:
+            _PLAN_JIT_CACHE.pop(next(iter(_PLAN_JIT_CACHE)))
+        _PLAN_JIT_CACHE[key] = jax.jit(partial(fwd, cfg))
+    return _PLAN_JIT_CACHE[key]
+
+
 def clear_generation_cache() -> None:
-    """Drop all memoized generation loops AND encoder jits (and their
+    """Drop all memoized generation loops AND encoder/plan jits (and their
     compiled executables)."""
     _GEN_LOOP_CACHE.clear()
     _ENCODE_JIT_CACHE.clear()
+    _PLAN_JIT_CACHE.clear()
 
 
 def _generation_loop(fwd, cfg, max_new_tokens, temperature, top_k, top_p,
@@ -1145,7 +1161,7 @@ def beam_search(
         raise ValueError(f"{t_max} tokens exceeds max_position_embeddings={max_pos}")
 
     cache = init_cache(cfg, b, t_max)
-    logits, cache = jax.jit(partial(fwd, cfg))(params, input_ids, cache)
+    logits, cache = _plan_jit(fwd, cfg)(params, input_ids, cache)
     logp = jax.nn.log_softmax(logits, axis=-1)  # (B, V)
     v = logp.shape[-1]
 
@@ -1162,7 +1178,7 @@ def beam_search(
     lengths = jnp.zeros((b, k), jnp.int32)
     tokens = jnp.zeros((b, k, max_new_tokens), jnp.int32)
 
-    decode = jax.jit(partial(fwd, cfg))
+    decode = _plan_jit(fwd, cfg)
     neg_inf = jnp.asarray(-jnp.inf)
 
     cand_logp = first

@@ -1,0 +1,30 @@
+"""Rank-aware tqdm (reference: utils/tqdm.py — ``main_process_only`` bars).
+
+``from accelerate_tpu.utils import tqdm`` draws the bar on the main process
+only, so an N-process gang prints one bar instead of N interleaved ones.
+"""
+
+from __future__ import annotations
+
+__all__ = ["tqdm"]
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in ``tqdm.auto.tqdm`` that is silent off the main process.
+
+    Accepts the same signature; ``main_process_only=False`` restores
+    per-process bars. Requires the ``tqdm`` package (raise mirrors the
+    reference's ImportError contract).
+    """
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError as e:  # pragma: no cover - tqdm is ubiquitous
+        raise ImportError(
+            "accelerate_tpu.utils.tqdm requires the tqdm package: pip install tqdm"
+        ) from e
+
+    if main_process_only:
+        from ..state import PartialState
+
+        kwargs.setdefault("disable", not PartialState().is_main_process)
+    return _tqdm(*args, **kwargs)

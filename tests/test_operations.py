@@ -142,3 +142,17 @@ def test_metrics_multiprocess():
     ]
     out = execute_subprocess(cmd, env={"PYTHONPATH": os.getcwd(), "XLA_FLAGS": ""})
     assert "TEST_METRICS OK" in out
+
+
+def test_tqdm_main_process_only():
+    """utils.tqdm: silent off the main process, live on it (reference:
+    utils/tqdm.py main_process_only contract)."""
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.utils import tqdm
+
+    PartialState()  # single process: IS main
+    bar = tqdm(range(3), main_process_only=True)
+    assert bar.disable is False
+    assert list(bar) == [0, 1, 2]
+    bar2 = tqdm(range(3), disable=True)
+    assert bar2.disable is True
