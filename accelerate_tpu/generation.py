@@ -914,15 +914,20 @@ _GEN_LOOP_CACHE_MAX = 32  # FIFO-evicted: callers varying settings per call
 _PLAN_JIT_CACHE: dict = {}
 
 
-def _plan_jit(fwd, cfg):
+def _plan_jit(fwd, cfg, static_return_all: bool = False):
     """Memoized ``jax.jit(partial(fwd, cfg))`` keyed by (fwd, cfg) — lets
-    beam_search reuse compiled prefill/decode across calls (registry plans
-    are stable keys; per-call enc-dec closures still rebuild)."""
-    key = (fwd, cfg)
+    beam_search/speculative reuse compiled prefill/decode across calls
+    (registry plans are stable keys; per-call enc-dec closures still
+    rebuild)."""
+    key = (fwd, cfg, static_return_all)
     if key not in _PLAN_JIT_CACHE:
         while len(_PLAN_JIT_CACHE) >= _GEN_LOOP_CACHE_MAX:
             _PLAN_JIT_CACHE.pop(next(iter(_PLAN_JIT_CACHE)))
-        _PLAN_JIT_CACHE[key] = jax.jit(partial(fwd, cfg))
+        _PLAN_JIT_CACHE[key] = (
+            jax.jit(partial(fwd, cfg), static_argnames=("return_all",))
+            if static_return_all
+            else jax.jit(partial(fwd, cfg))
+        )
     return _PLAN_JIT_CACHE[key]
 
 
@@ -1047,8 +1052,8 @@ def speculative_generate(
     if t_max > min(_cache_dims(cfg)[3], _cache_dims(dcfg)[3]):
         raise ValueError("sequence would exceed max positions")
 
-    target_step = jax.jit(partial(fwd, cfg), static_argnames=("return_all",))
-    draft_step = jax.jit(partial(dfwd, dcfg))
+    target_step = _plan_jit(fwd, cfg, static_return_all=True)
+    draft_step = _plan_jit(dfwd, dcfg)
 
     out = input_ids
     tcache = init_cache(cfg, b, t_max)
