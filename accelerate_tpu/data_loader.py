@@ -432,6 +432,7 @@ class BaseDataLoader:
         self.gradient_state = GradientState()
         self.end_of_dataloader = False
         self.remainder = -1
+        self._drop_last = _drop_last
         self._iter_count = 0
         # Mid-epoch resume (reference: StatefulDataLoader state_dict surgery,
         # data_loader.py:416-508): batches handed out in the CURRENT epoch;
@@ -565,7 +566,11 @@ class BaseDataLoader:
         """Register with GradientState (reference: data_loader.py:402-408)."""
         total_bs = self.total_batch_size
         total_len = self.total_dataset_length
-        if total_bs and total_len is not None:
+        # drop_last loaders never pad, so there is no duplicate tail for
+        # gather_for_metrics to trim (reference guards begin() the same way,
+        # data_loader.py:402-408); trimming anyway would chop real samples
+        # off the final full batch.
+        if total_bs and total_len is not None and not self._drop_last:
             # Duplicate-sample count on the final gathered batch, consumed by
             # gather_for_metrics (reference: accelerator.py:3068-3140).
             self.remainder = total_len % total_bs
@@ -822,6 +827,7 @@ def prepare_data_loader(
             device_placement=put_on_device,
             rng_types=rng_types,
             prefetch_size=prefetch_size,
+            _drop_last=drop_last,
         )
 
     if use_seedable_sampler and shuffle:
@@ -850,6 +856,7 @@ def prepare_data_loader(
             device_placement=put_on_device,
             rng_types=rng_types,
             prefetch_size=prefetch_size,
+            _drop_last=drop_last,
         )
     sharded = BatchSamplerShard(
         inner,
@@ -865,6 +872,7 @@ def prepare_data_loader(
         device_placement=put_on_device,
         rng_types=rng_types,
         prefetch_size=prefetch_size,
+        _drop_last=drop_last,
     )
 
 

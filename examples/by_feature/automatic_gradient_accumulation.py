@@ -1,0 +1,57 @@
+"""Feature: automatic gradient accumulation — combine
+find_executable_batch_size with gradient_accumulation_steps so the OOM-retry
+loop keeps the EFFECTIVE batch constant by accumulating what no longer fits
+(reference: examples/by_feature/automatic_gradient_accumulation.py)."""
+
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+    observed_batch_size = args.batch_size * 2  # pretend this is what we want
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=observed_batch_size)
+    def inner_training_loop(batch_size):
+        attempts.append(batch_size)
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(args.seed)
+        # The feature: as the per-step batch halves, accumulation doubles, so
+        # every attempt optimizes with the same effective batch.
+        accum = observed_batch_size // batch_size
+        accelerator = Accelerator(
+            mixed_precision=args.mixed_precision, gradient_accumulation_steps=accum
+        )
+        # Simulate an OOM on the first (oversized) attempt so the retry loop
+        # is exercised even on hosts with plenty of memory.
+        if batch_size > args.batch_size:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Ran out of memory (simulated)")
+        module, model, train_ds, eval_ds = build_model_and_data(args)
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optax.adamw(args.lr), LoaderSpec(train_ds, batch_size),
+            LoaderSpec(eval_ds, batch_size, shuffle=False),
+        )
+        step_fn = accelerator.prepare_train_step(classifier_loss(module))
+        state = accelerator.train_state
+        for batch in train_dl:
+            state, metrics = step_fn(state, batch)
+        return evaluate(accelerator, model, eval_dl), accum, accelerator
+
+    acc, accum, accelerator = inner_training_loop()
+    accelerator.print(
+        f"auto grad-accum OK: tried {attempts}, settled on accumulation x{accum}, "
+        f"accuracy {acc:.3f}"
+    )
+    assert accum == 2, f"expected accumulation 2 after one halving, got {accum}"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Feature: k-fold cross-validation — train one model per fold on the
+non-held-out shards, evaluate on the held-out fold, and report the mean
+accuracy across folds (reference: examples/by_feature/cross_validation.py,
+which folds with `datasets` + StratifiedKFold; the fold arithmetic here is
+plain index slicing over the same base dataset)."""
+
+import numpy as np
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, evaluate, make_parser
+
+
+def fold_split(n, k, fold):
+    """Contiguous k-fold: returns (train_idx, eval_idx) for this fold."""
+    edges = np.linspace(0, n, k + 1, dtype=int)
+    lo, hi = edges[fold], edges[fold + 1]
+    eval_idx = np.arange(lo, hi)
+    train_idx = np.concatenate([np.arange(0, lo), np.arange(hi, n)])
+    return train_idx, eval_idx
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    args.num_folds = 3
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    fold_accuracies = []
+    for fold in range(args.num_folds):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        set_seed(args.seed)
+        accelerator = Accelerator(mixed_precision=args.mixed_precision)
+        module, model, full_ds, _ = build_model_and_data(args, n_train=768, n_eval=1)
+        train_idx, eval_idx = fold_split(len(full_ds), args.num_folds, fold)
+        train_ds = [full_ds[i] for i in train_idx]
+        eval_ds = [full_ds[i] for i in eval_idx]
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size),
+            LoaderSpec(eval_ds, args.batch_size, shuffle=False),
+        )
+        step_fn = accelerator.prepare_train_step(classifier_loss(module))
+        state = accelerator.train_state
+        for _ in range(args.epochs):
+            for batch in train_dl:
+                state, _ = step_fn(state, batch)
+        acc = evaluate(accelerator, model, eval_dl)
+        fold_accuracies.append(acc)
+        accelerator.print(f"fold {fold}: accuracy {acc:.3f}")
+
+    mean_acc = float(np.mean(fold_accuracies))
+    accelerator.print(f"cross-validation OK: mean accuracy {mean_acc:.3f} over {args.num_folds} folds")
+    assert mean_acc > 0.5, f"cross-validated model failed to learn ({mean_acc:.3f})"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Feature: correct multi-process metrics with gather_for_metrics — the
+gather trims duplicate samples that even_batches padding added on the final
+uneven batch, so every eval sample is counted exactly once
+(reference: examples/by_feature/multi_process_metrics.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from _base import LoaderSpec, build_model_and_data, classifier_loss, make_parser
+
+
+def main():
+    args = make_parser(epochs=1).parse_args()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    # 250 eval samples with batch 32: the final batch is short and padded
+    # across ranks — exactly the case gather_for_metrics exists for.
+    module, model, train_ds, eval_ds = build_model_and_data(args, n_eval=250)
+    eval_spec = LoaderSpec(eval_ds, args.batch_size, shuffle=False)
+    eval_spec.drop_last = False  # keep the short batch; even_batches pads it
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        model, optax.adamw(args.lr), LoaderSpec(train_ds, args.batch_size), eval_spec,
+    )
+    step_fn = accelerator.prepare_train_step(classifier_loss(module))
+    state = accelerator.train_state
+    for batch in train_dl:
+        state, _ = step_fn(state, batch)
+
+    correct = total = 0
+    for batch in eval_dl:
+        preds = jnp.argmax(model(batch["input_ids"], batch["attention_mask"]), -1)
+        # The feature: gather across processes AND drop the padded remainder.
+        preds, labels = accelerator.gather_for_metrics((preds, batch["labels"]))
+        correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+        total += len(np.asarray(preds))
+
+    assert total == 250, f"gather_for_metrics must count each sample once, got {total}"
+    accelerator.print(f"multi-process metrics OK: {total} samples, accuracy {correct / total:.3f}")
+
+
+if __name__ == "__main__":
+    main()

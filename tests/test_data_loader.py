@@ -135,6 +135,31 @@ def test_end_of_dataloader_flag():
     assert flags == [False, False, False, True]
 
 
+def test_drop_last_loader_sets_no_remainder():
+    """drop_last loaders never pad, so gather_for_metrics must not trim the
+    final (full) batch — regression for the 6-samples-chopped bug where
+    remainder was set to len(ds) % batch even though the short batch had
+    been dropped."""
+    from accelerate_tpu import AcceleratorState, prepare_data_loader
+
+    AcceleratorState()
+    ds = _ToyDataset(n=90)  # batch 32 -> 2 full batches kept, 26 dropped
+    spec = _LoaderSpec(ds, batch_size=32)
+    spec.drop_last = True
+    dl = prepare_data_loader(spec, put_on_device=False)
+    remainders, sizes = [], []
+    for b in dl:
+        remainders.append(dl.remainder)
+        sizes.append(len(b["x"]))
+    assert sizes == [32, 32]
+    assert all(r <= 0 for r in remainders), remainders
+    # Without drop_last the padded tail IS trimmed via remainder.
+    spec2 = _LoaderSpec(ds, batch_size=32)
+    dl2 = prepare_data_loader(spec2, put_on_device=False)
+    sizes2 = [len(b["x"]) for b in dl2]
+    assert sum(sizes2) == 96 and dl2.remainder == 90 % 32
+
+
 def test_skip_first_batches():
     from accelerate_tpu import AcceleratorState, prepare_data_loader, skip_first_batches
 

@@ -79,6 +79,11 @@ _BY_FEATURE_OK = {
     "generation.py": "generation OK",
     "megatron_import.py": "megatron import OK",
     "pipeline_inference.py": "pipeline inference over",
+    "automatic_gradient_accumulation.py": "auto grad-accum OK",
+    "multi_process_metrics.py": "multi-process metrics OK",
+    "schedule_free.py": "schedule_free OK",
+    "cross_validation.py": "cross-validation OK",
+    "fsdp_with_peak_mem_tracking.py": "fsdp peak-mem OK",
 }
 
 
@@ -139,6 +144,11 @@ _FEATURE_MARKERS = {
     "generation.py": ["generate"],
     "megatron_import.py": ["load_megatron_checkpoint", "merge_megatron_tp_shards"],
     "pipeline_inference.py": ["prepare_pippy"],
+    "automatic_gradient_accumulation.py": ["find_executable_batch_size", "gradient_accumulation_steps"],
+    "multi_process_metrics.py": ["gather_for_metrics"],
+    "schedule_free.py": ["schedule_free_adamw", "schedule_free_eval_params"],
+    "cross_validation.py": ["fold_split"],
+    "fsdp_with_peak_mem_tracking.py": ["FullyShardedDataParallelPlugin", "memory_stats"],
 }
 
 
