@@ -194,7 +194,14 @@ class Accelerator:
         self._save_state_pre_hooks: list[Callable] = []
         self._load_state_pre_hooks: list[Callable] = []
 
-        self._train_state: Optional[TrainState] = None
+        # One TrainState per prepared model ("slot"); slot 0 is the primary
+        # and backs the legacy single-model surface (_train_state property,
+        # imperative backward, LocalSGD). Multi-model training — GANs,
+        # distillation, RLHF — prepares several models and steps each through
+        # prepare_train_step(loss_fn, model=...) (reference trains multiple
+        # models per Accelerator natively since torch params live on modules).
+        self._train_states: list[TrainState] = []
+        self._slot_meta: list[dict] = []  # per-slot sharding plans
         self._state_shardings = None
         self._grad_shardings = None  # ZeRO-2 reduce-scatter constraint
         self._opt_offload = None     # (device, host) opt shardings under cpu_offload
@@ -379,6 +386,22 @@ class Accelerator:
         self._train_state = value
 
     @property
+    def _train_state(self) -> Optional[TrainState]:
+        """Primary (slot-0) train state; None before prepare()."""
+        states = getattr(self, "_train_states", None)
+        return states[0] if states else None
+
+    @_train_state.setter
+    def _train_state(self, value: Optional[TrainState]):
+        if value is None:
+            self._train_states = []
+            self._slot_meta = []
+        elif getattr(self, "_train_states", None):
+            self._train_states[0] = value
+        else:
+            self._train_states = [value]
+
+    @property
     def state_shardings(self):
         return self._state_shardings
 
@@ -432,27 +455,45 @@ class Accelerator:
         """Prepare model/optimizer/dataloader/scheduler objects in any order,
         returning them in the same order (reference: accelerator.py:1414)."""
         result = []
-        model = next((a for a in args if isinstance(a, Model)), None)
-        tx = next((a for a in args if _is_optax_tx(a)), None)
-        if model is not None and self.verify_device_map(model):
-            # Same guard as the reference (accelerator.py:3744-3760): a model
-            # dispatched across HBM/host/disk cannot also be prepared for
-            # distributed training — its params aren't a mesh-shardable tree.
-            raise ValueError(
-                "You can't train a model that has been dispatched with a "
-                "multi-placement device_map (offloaded to cpu/disk). Load the "
-                "model on-device (or shard it with a ParallelismConfig mesh) "
-                "before calling prepare()."
-            )
+        models = [a for a in args if isinstance(a, Model)]
+        txs = [a for a in args if _is_optax_tx(a)]
+        for model in models:
+            if self.verify_device_map(model):
+                # Same guard as the reference (accelerator.py:3744-3760): a
+                # model dispatched across HBM/host/disk cannot also be
+                # prepared for distributed training — its params aren't a
+                # mesh-shardable tree.
+                raise ValueError(
+                    "You can't train a model that has been dispatched with a "
+                    "multi-placement device_map (offloaded to cpu/disk). Load the "
+                    "model on-device (or shard it with a ParallelismConfig mesh) "
+                    "before calling prepare()."
+                )
 
-        if model is not None:
-            self._prepare_state(model, tx)
+        # Models pair with optimizers in order of appearance (the torch
+        # reference gets this pairing implicitly from param references; a
+        # functional optimizer has none, so order is the contract). A lone
+        # trailing model without an optimizer is prepared inference-only.
+        for i, model in enumerate(models):
+            self._prepare_state(model, txs[i] if i < len(txs) else None)
+        tx_seen = 0
 
         for obj in args:
             if isinstance(obj, Model):
                 result.append(self.prepare_model(obj))
             elif _is_optax_tx(obj):
-                result.append(self.prepare_optimizer(obj))
+                # Pairing already bound this tx to its model's slot above;
+                # prepare_optimizer must only wrap, not re-bind it to some
+                # other (optimizer-less) slot — e.g. a frozen teacher.
+                slot_for_tx = (
+                    models[tx_seen]._state_slot if tx_seen < len(models) else None
+                )
+                result.append(
+                    self.prepare_optimizer(
+                        obj, _already_bound=bool(models), _bound_slot=slot_for_tx
+                    )
+                )
+                tx_seen += 1
             elif isinstance(obj, AcceleratedOptimizer):
                 result.append(obj)
             elif _is_dataloader_like(obj):
@@ -542,7 +583,7 @@ class Accelerator:
             tx=tx,
         )
         rep = replicated(mesh)
-        self._state_shardings = TrainState(
+        state_shardings = TrainState(
             step=rep,
             params=param_shardings,
             opt_state=opt_shardings,
@@ -552,8 +593,33 @@ class Accelerator:
             apply_fn=model.apply_fn,
             tx=tx,
         )
-        self._train_state = state
-        self._param_shardings = param_shardings
+        # Commit into this model's slot. _plan_opt_shardings/_build_opt_shardings
+        # recorded their results in the flat attrs; snapshot them per-slot,
+        # then restore the flat attrs to slot 0's plans (the legacy surface).
+        meta = {
+            "state_shardings": state_shardings,
+            "param_shardings": param_shardings,
+            "grad_shardings": self._grad_shardings,
+            "opt_offload": self._opt_offload,
+        }
+        slot = getattr(model, "_state_slot", None)
+        if getattr(model, "_accelerator", None) is not None and model._accelerator is not self:
+            slot = None  # model was bound to a previous Accelerator; its slot is stale
+        if slot is None or slot >= len(self._train_states):
+            slot = len(self._train_states)
+            self._train_states.append(state)
+            self._slot_meta.append(meta)
+        else:
+            self._train_states[slot] = state
+            self._slot_meta[slot] = meta
+        model._state_slot = slot
+        if slot == 0:
+            self._state_shardings = state_shardings
+            self._param_shardings = param_shardings
+        else:
+            primary = self._slot_meta[0]
+            self._grad_shardings = primary["grad_shardings"]
+            self._opt_offload = primary["opt_offload"]
 
     def _plan_opt_shardings(self, model, param_shardings, mesh, cfg):
         """ZeRO-1/2 (SHARD_GRAD_OP) + cpu_offload planning.
@@ -624,38 +690,77 @@ class Accelerator:
         return opt_shardings
 
     def prepare_model(self, model: Model, device_placement=None, evaluation_mode: bool = False) -> Model:
-        if self._train_state is None:
+        if getattr(model, "_state_slot", None) is None:
             self._prepare_state(model, None)
         model._accelerator = self
         model._params = None  # canonical copy now lives in the TrainState
         model._accelerate_prepared = True
-        self._models.append(model)
+        if model not in self._models:
+            self._models.append(model)
         return model
 
-    def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:
+    def prepare_optimizer(
+        self,
+        optimizer,
+        device_placement=None,
+        _already_bound: bool = False,
+        _bound_slot: Optional[int] = None,
+    ) -> AcceleratedOptimizer:
         if isinstance(optimizer, AcceleratedOptimizer):
             return optimizer
         wrapped = AcceleratedOptimizer(
             optimizer, device_placement=device_placement or self.device_placement, accelerator=self
         )
-        if self._train_state is not None and self._train_state.tx is None:
-            state = self._train_state
-            model = self._models[-1] if self._models else None
+        wrapped._state_slot = _bound_slot if _already_bound else None
+        # Bind to the first prepared model still missing an optimizer (slot
+        # order == order of appearance in prepare()); skipped when prepare()'s
+        # model/optimizer pairing already bound this tx.
+        slot = (
+            None
+            if _already_bound
+            else next((i for i, st in enumerate(self._train_states) if st.tx is None), None)
+        )
+        if slot is not None:
+            state = self._train_states[slot]
+            model = next(
+                (m for m in self._models if getattr(m, "_state_slot", None) == slot),
+                self._models[-1] if self._models else None,
+            )
+            if slot >= len(self._slot_meta):
+                # State installed directly (not via _prepare_state): keep the
+                # flat-attr plans as its meta.
+                self._slot_meta.extend(
+                    {"state_shardings": self._state_shardings,
+                     "param_shardings": self._param_shardings,
+                     "grad_shardings": self._grad_shardings,
+                     "opt_offload": self._opt_offload}
+                    for _ in range(slot + 1 - len(self._slot_meta))
+                )
+            meta = self._slot_meta[slot]
+            param_shardings = meta["param_shardings"]
             cfg = self.state.parallelism_config or ParallelismConfig()
             if model is not None:
                 opt_shardings = self._build_opt_shardings(
-                    model, state.params, self._param_shardings, optimizer, cfg
+                    model, state.params, param_shardings, optimizer, cfg
                 )
+                meta["grad_shardings"] = self._grad_shardings
+                meta["opt_offload"] = self._opt_offload
+                if slot != 0:
+                    self._grad_shardings = self._slot_meta[0]["grad_shardings"]
+                    self._opt_offload = self._slot_meta[0]["opt_offload"]
             else:
                 opt_shapes = jax.eval_shape(optimizer.init, state.params)
                 opt_shardings = infer_opt_state_sharding(
-                    opt_shapes, state.params, self._param_shardings, self.mesh
+                    opt_shapes, state.params, param_shardings, self.mesh
                 )
             opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(state.params)
-            self._train_state = state.replace(opt_state=opt_state, tx=optimizer)
-            self._state_shardings = self._state_shardings.replace(
+            self._train_states[slot] = state.replace(opt_state=opt_state, tx=optimizer)
+            wrapped._state_slot = slot
+            meta["state_shardings"] = meta["state_shardings"].replace(
                 opt_state=opt_shardings, tx=optimizer
             )
+            if slot == 0:
+                self._state_shardings = meta["state_shardings"]
         self._optimizers.append(wrapped)
         return wrapped
 
@@ -886,6 +991,7 @@ class Accelerator:
         mutable_state: bool = False,
         max_grad_norm: Optional[float] = None,
         donate: Optional[bool] = None,
+        model: Optional[Model] = None,
     ) -> Callable:
         """Build ONE jitted step: ``step(state, batch) -> (state, metrics)``.
 
@@ -907,18 +1013,30 @@ class Accelerator:
           cross-device means — sync-BN semantics with no extra machinery
           (the reference needs SyncBatchNorm conversion for this).
         """
-        if self._train_state is None:
+        if not self._train_states:
             raise RuntimeError("Call accelerator.prepare(...) first.")
         if mutable_state and has_aux:
             raise ValueError("mutable_state and has_aux are mutually exclusive")
+        # Multi-model: `model=` selects whose TrainState this step advances
+        # (each prepared model owns a slot); default is the primary.
+        slot = 0
+        if model is not None:
+            slot = getattr(model, "_state_slot", None)
+            if slot is None or model._accelerator is not self:
+                raise ValueError("model was not prepared by this Accelerator")
         if donate is None:
             donate = self.jit_config.donate_state
         policy = self._mp_policy
-        tx = self._train_state.tx
+        tx = self._train_states[slot].tx
         num_accum = self.gradient_state.num_steps
         clip_enabled = max_grad_norm is not None
         max_norm = float(max_grad_norm or 0.0)
-        grad_shardings = self._grad_shardings  # ZeRO-2: reduce-scatter grads
+        meta = (
+            self._slot_meta[slot]
+            if slot < len(self._slot_meta)
+            else {"grad_shardings": self._grad_shardings, "opt_offload": self._opt_offload}
+        )
+        grad_shardings = meta["grad_shardings"]  # ZeRO-2: reduce-scatter grads
 
         def _loss_and_grads(params, extra, loss_scale, microbatch):
             def _fn(p):
@@ -940,7 +1058,7 @@ class Accelerator:
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             return loss, aux, new_extra, grads
 
-        opt_offload = self._opt_offload  # (device shardings, host shardings) | None
+        opt_offload = meta["opt_offload"]  # (device shardings, host shardings) | None
 
         def _update(state: TrainState, grads):
             if state.loss_scale is not None:
@@ -1033,7 +1151,7 @@ class Accelerator:
             # Keep the accelerator's view current: with buffer donation the
             # previous state's arrays are dead after this call, so save_state,
             # Model.__call__ and trackers must see the new one.
-            self._train_state = new_state
+            self._train_states[slot] = new_state
             return new_state, metrics
 
         return step_and_track

@@ -199,6 +199,12 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     # sharded-state-dict dirs; restore reshards to whatever mesh is live).
     plugin = getattr(accelerator, "fsdp_plugin", None)
     if plugin is not None and plugin.state_dict_type == "DISTRIBUTED_STATE_DICT":
+        if len(accelerator._train_states) > 1:
+            raise NotImplementedError(
+                "DISTRIBUTED_STATE_DICT checkpointing currently saves a single "
+                "prepared model; use FULL/SHARDED_STATE_DICT for multi-model "
+                "training runs."
+            )
         _save_distributed_state(accelerator, state, output_dir)
         _save_host_side_state(accelerator, state, output_dir)
         if pc.automatic_checkpoint_naming:
@@ -234,6 +240,34 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
             pickle.dump(
                 {"opt_state": opt_host, "step": step_host, "extra_state": extra_host}, f
             )
+
+    # Multi-model slots (GAN/distillation): reference filename convention —
+    # model_1.safetensors / optimizer_1.bin per extra prepared model
+    # (reference: checkpointing.py save_accelerator_state enumerates models).
+    for i, extra_st in enumerate(accelerator._train_states[1:], start=1):
+        # Gathers are collectives — EVERY process must enter them; only the
+        # file writes are rank-0 (same split as the primary path above).
+        params_host_i = to_global_host(extra_st.params)
+        opt_host_i = jax.tree.map(
+            lambda x: to_global_host(x) if hasattr(x, "shape") else x,
+            extra_st.opt_state,
+        )
+        extra_host_i = (
+            jax.tree.map(to_global_host, extra_st.extra_state)
+            if extra_st.extra_state else None
+        )
+        if accelerator.is_main_process:
+            save_sharded_safetensors(
+                flatten_state_dict(params_host_i), output_dir,
+                max_shard_size=max_shard, weights_name=f"{MODEL_NAME}_{i}.safetensors",
+            )
+            payload = {
+                "opt_state": opt_host_i,
+                "step": int(np.asarray(extra_st.step)),
+                "extra_state": extra_host_i,
+            }
+            with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "wb") as f:
+                pickle.dump(payload, f)
     _save_host_side_state(accelerator, state, output_dir)
 
     if pc.automatic_checkpoint_naming:
@@ -320,6 +354,48 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         loss_scale=loss_scale,
         extra_state=extra_state,
     )
+
+    # Extra model slots (multi-model training): model_{i}.safetensors +
+    # optimizer_{i}.bin, restored into each slot's own sharding plan.
+    for i, extra_st in enumerate(accelerator._train_states[1:], start=1):
+        weights_name = f"{MODEL_NAME}_{i}.safetensors"
+        if not (
+            os.path.exists(os.path.join(input_dir, weights_name))
+            or os.path.exists(os.path.join(input_dir, weights_name + ".index.json"))
+        ):
+            continue
+        slot_sh = accelerator._slot_meta[i]["state_shardings"]
+        flat_i = load_sharded_safetensors(input_dir, weights_name=weights_name)
+        params_i = _remap(jax.tree.map(lambda x: x, extra_st.params), unflatten_state_dict(flat_i))
+        new_params_i = jax.tree.map(
+            lambda arr, s: jax.device_put(arr, s), params_i, slot_sh.params
+        )
+        with open(os.path.join(input_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "rb") as f:
+            payload_i = pickle.load(f)
+        new_opt_i = jax.tree.map(
+            lambda arr, s: jax.device_put(np.asarray(arr), s)
+            if hasattr(arr, "shape") or np.isscalar(arr)
+            else arr,
+            payload_i["opt_state"],
+            slot_sh.opt_state,
+        )
+        extra_i = extra_st.extra_state
+        if payload_i.get("extra_state") is not None and extra_i is not None:
+            extra_sh_i = getattr(slot_sh, "extra_state", None)
+            extra_i = (
+                jax.tree.map(
+                    lambda a, s: jax.device_put(np.asarray(a), s),
+                    payload_i["extra_state"], extra_sh_i,
+                )
+                if extra_sh_i is not None
+                else jax.tree.map(lambda a: jnp.asarray(a), payload_i["extra_state"])
+            )
+        accelerator._train_states[i] = extra_st.replace(
+            step=jnp.asarray(payload_i["step"], jnp.int32),
+            params=new_params_i,
+            opt_state=new_opt_i,
+            extra_state=extra_i,
+        )
 
     _load_host_side_state(accelerator, input_dir)
 

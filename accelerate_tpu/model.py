@@ -58,16 +58,32 @@ class Model:
 
     # -- state access ----------------------------------------------------
 
+    def _bound_state(self):
+        """This model's TrainState slot on its Accelerator, or None. Each
+        prepared model owns one slot (multi-model training: GAN/distillation);
+        _state_slot is assigned by Accelerator._prepare_state."""
+        acc = self._accelerator
+        if acc is None:
+            return None
+        states = getattr(acc, "_train_states", None)
+        if not states:
+            return None
+        slot = getattr(self, "_state_slot", 0) or 0
+        return states[slot] if slot < len(states) else None
+
     @property
     def params(self):
-        if self._accelerator is not None and self._accelerator._train_state is not None:
-            return self._accelerator._train_state.params
+        state = self._bound_state()
+        if state is not None:
+            return state.params
         return self._params
 
     @params.setter
     def params(self, value):
-        if self._accelerator is not None and self._accelerator._train_state is not None:
-            self._accelerator._train_state = self._accelerator._train_state.replace(params=value)
+        state = self._bound_state()
+        if state is not None:
+            slot = getattr(self, "_state_slot", 0) or 0
+            self._accelerator._train_states[slot] = state.replace(params=value)
         else:
             self._params = value
 
@@ -104,13 +120,13 @@ class Model:
     # -- forward ---------------------------------------------------------
 
     def __call__(self, *args, rngs=None, train: bool = False, **kwargs):
-        params = self.params
-        extra = self.extra_state
-        if self._accelerator is not None and self._accelerator._train_state is not None:
-            # Live view: after jitted steps (which donate the old buffers) the
-            # accelerator's train state holds the current params.
-            params = self._accelerator._train_state.params
-            extra = self._accelerator._train_state.extra_state
+        # Live view: after jitted steps (which donate the old buffers) this
+        # model's slot on the accelerator holds the current params.
+        state = self._bound_state()
+        if state is not None:
+            params, extra = state.params, state.extra_state
+        else:
+            params, extra = self._params, self.extra_state
         variables = {"params": params}
         if extra:
             variables.update(extra)

@@ -28,6 +28,11 @@ class AcceleratedOptimizer:
         self.gradient_state = GradientState()
         self.device_placement = device_placement
         self._accelerator = accelerator
+        # Which TrainState slot this optimizer's tx was bound to (multi-model
+        # prepare); None/0 = the primary. The imperative step() path only
+        # serves the primary — non-primary models step through
+        # accelerator.prepare_train_step(loss_fn, model=...).
+        self._state_slot: Optional[int] = None
         self._is_overflow = False
         self._accumulated: Optional[Any] = None
         self._micro_count = 0
@@ -37,8 +42,11 @@ class AcceleratedOptimizer:
 
     @property
     def state(self):
-        if self._accelerator is not None and self._accelerator._train_state is not None:
-            return self._accelerator._train_state.opt_state
+        if self._accelerator is not None:
+            states = getattr(self._accelerator, "_train_states", None)
+            slot = self._state_slot or 0
+            if states and slot < len(states):
+                return states[slot].opt_state
         return None
 
     @property
@@ -77,6 +85,12 @@ class AcceleratedOptimizer:
             raise RuntimeError(
                 "This AcceleratedOptimizer is not bound to an Accelerator; "
                 "pass it through `accelerator.prepare(...)` first."
+            )
+        if self._state_slot not in (None, 0):
+            raise NotImplementedError(
+                "The imperative backward()/optimizer.step() surface serves the "
+                "primary (first-prepared) model only. Step additional models "
+                "through accelerator.prepare_train_step(loss_fn, model=...)."
             )
         if self._accumulated is None:
             return

@@ -289,6 +289,31 @@ def _parse_last_json(text: str):
     return None
 
 
+def _backend_probe(timeout_s: int = 90) -> tuple[bool, str]:
+    """Cheap pre-flight: can a fresh process see a device at all?
+
+    A dead axon relay makes ``jax.devices()`` hang forever, so without this
+    probe every child attempt burns its full 20-minute timeout (observed in
+    round 3: three doomed children = one hour of budget on a relay that was
+    down the whole time). Probing costs <=90 s and lets the supervisor spend
+    the budget *waiting for the relay to come back* instead.
+
+    Returns ``(ok, error_text)``; error_text is "timeout" for a hang (the
+    relay-down signature, worth waiting out) and the probe's stderr for a
+    fast deterministic failure (broken install — NOT worth waiting out).
+    """
+    probe = "import jax; jax.devices(); print('ok')"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
+        )
+        if r.returncode == 0 and "ok" in (r.stdout or ""):
+            return True, ""
+        return False, (r.stderr or r.stdout or "")[-2000:]
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+
+
 def supervise() -> int:
     """Run the child with retries so one transient backend failure can never
     again erase a round's perf evidence (round-2 postmortem)."""
@@ -303,6 +328,22 @@ def supervise() -> int:
         if remaining < 120:
             last_err = last_err or "supervisor wall-clock budget exhausted"
             break
+        alive, probe_err = _backend_probe()
+        if not alive:
+            if probe_err != "timeout" and not any(
+                pat in probe_err.lower() for pat in RETRYABLE
+            ):
+                # Fast deterministic failure (bad install/config): retrying
+                # cannot help — fail now with the real stderr.
+                last_err = f"backend probe failed deterministically:\n{probe_err}"
+                break
+            # Hang or retryable error: relay down — wait it out (cheap)
+            # rather than burn a 20-min child timeout. Probe failures don't
+            # consume child attempts; the wall-clock deadline bounds this.
+            last_err = f"attempt {attempt}: backend probe failed ({probe_err[:200]})"
+            attempt -= 1
+            time.sleep(60)
+            continue
         cmd = [sys.executable, os.path.abspath(__file__), "--child", f"--oom-level={oom_level}"]
         try:
             # A healthy child (both seqs, incl. remote compiles) finishes well

@@ -1,0 +1,172 @@
+"""Multi-model training: several prepared models per Accelerator, each with
+its own TrainState slot (reference trains multiple models natively — GANs,
+distillation, RLHF; see docs/source/usage_guides/deepspeed_multiple_model.md
+and accelerator.py _models registry)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class _Dense(nn.Module):
+    feats: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(16)(x)
+        return nn.Dense(self.feats)(nn.relu(h))
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    yield
+
+
+def _models_and_data():
+    from accelerate_tpu import Model
+
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    m1 = Model.from_flax(_Dense(3), jax.random.key(0), x)
+    m2 = Model.from_flax(_Dense(5), jax.random.key(1), x)
+    return m1, m2, x
+
+
+def test_second_prepare_does_not_corrupt_first():
+    """Round-3 regression: preparing model B used to repoint model A's
+    params view at B's TrainState."""
+    from accelerate_tpu import Accelerator
+
+    m1, m2, x = _models_and_data()
+    acc = Accelerator()
+    m1, _ = acc.prepare(m1, optax.adam(1e-3))
+    out1_before = np.asarray(m1(x))
+    m2 = acc.prepare(m2)
+    assert m1(x).shape == (8, 3)
+    assert m2(x).shape == (8, 5)
+    np.testing.assert_allclose(np.asarray(m1(x)), out1_before, rtol=1e-6)
+
+
+def test_two_models_two_optimizers_step_independently():
+    """GAN shape: prepare(m1, tx1, m2, tx2); each model steps through its own
+    fused step; stepping one leaves the other's params untouched."""
+    from accelerate_tpu import Accelerator
+
+    m1, m2, x = _models_and_data()
+    y1 = np.zeros((8, 3), np.float32)
+    y2 = np.zeros((8, 5), np.float32)
+    acc = Accelerator()
+    m1, o1, m2, o2 = acc.prepare(m1, optax.adam(1e-2), m2, optax.sgd(1e-2))
+
+    mod1, mod2 = m1.module, m2.module
+
+    def loss1(params, batch):
+        return jnp.mean((mod1.apply({"params": params}, batch["x"]) - batch["y1"]) ** 2)
+
+    def loss2(params, batch):
+        return jnp.mean((mod2.apply({"params": params}, batch["x"]) - batch["y2"]) ** 2)
+
+    step1 = acc.prepare_train_step(loss1, model=m1)
+    step2 = acc.prepare_train_step(loss2, model=m2)
+    batch = {"x": x, "y1": y1, "y2": y2}
+
+    p1_init = jax.tree.map(np.asarray, m1.params)
+    p2_init = jax.tree.map(np.asarray, m2.params)
+
+    s1 = acc._train_states[m1._state_slot]
+    s1, metrics1 = step1(s1, batch)
+    # m2 untouched by m1's step.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b), m2.params, p2_init
+    )
+    changed = jax.tree.leaves(
+        jax.tree.map(lambda a, b: bool(np.any(np.asarray(a) != b)), m1.params, p1_init)
+    )
+    assert any(changed), "m1 did not train"
+
+    s2 = acc._train_states[m2._state_slot]
+    s2, metrics2 = step2(s2, batch)
+    changed2 = jax.tree.leaves(
+        jax.tree.map(lambda a, b: bool(np.any(np.asarray(a) != b)), m2.params, p2_init)
+    )
+    assert any(changed2), "m2 did not train"
+    # Both losses decrease over a few steps.
+    for _ in range(5):
+        s1, metrics1b = step1(s1, batch)
+        s2, metrics2b = step2(s2, batch)
+    assert float(metrics1b["loss"]) < float(metrics1["loss"])
+    assert float(metrics2b["loss"]) < float(metrics2["loss"])
+
+
+def test_teacher_student_distillation():
+    """Teacher prepared inference-only (no optimizer); student trains against
+    its outputs — the no-tx slot stays frozen."""
+    from accelerate_tpu import Accelerator, Model
+
+    x = np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32)
+    teacher = Model.from_flax(_Dense(3), jax.random.key(2), x)
+    student = Model.from_flax(_Dense(3), jax.random.key(3), x)
+    acc = Accelerator()
+    # Order: student pairs with the optimizer, teacher gets none.
+    student, tx, teacher = acc.prepare(student, optax.adam(1e-2), teacher)
+    assert acc._train_states[teacher._state_slot].tx is None
+
+    smod = student.module
+    targets = np.asarray(teacher(x))
+
+    def loss(params, batch):
+        return jnp.mean((smod.apply({"params": params}, batch["x"]) - batch["t"]) ** 2)
+
+    step = acc.prepare_train_step(loss, model=student)
+    s = acc._train_states[student._state_slot]
+    first = None
+    for _ in range(10):
+        s, metrics = step(s, {"x": x, "t": targets})
+        first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    # Teacher unchanged and still queryable.
+    np.testing.assert_allclose(np.asarray(teacher(x)), targets, rtol=1e-6)
+
+
+def test_multi_model_checkpoint_roundtrip(tmp_path):
+    from accelerate_tpu import Accelerator
+
+    m1, m2, x = _models_and_data()
+    acc = Accelerator()
+    m1, o1, m2, o2 = acc.prepare(m1, optax.adam(1e-2), m2, optax.adam(1e-2))
+
+    mod1, mod2 = m1.module, m2.module
+
+    def loss1(params, batch):
+        return jnp.mean(mod1.apply({"params": params}, batch) ** 2)
+
+    def loss2(params, batch):
+        return jnp.mean(mod2.apply({"params": params}, batch) ** 2)
+
+    s1 = acc._train_states[m1._state_slot]
+    s2 = acc._train_states[m2._state_slot]
+    s1, _ = acc.prepare_train_step(loss1, model=m1)(s1, x)
+    s2, _ = acc.prepare_train_step(loss2, model=m2)(s2, x)
+    p1 = jax.tree.map(np.asarray, m1.params)
+    p2 = jax.tree.map(np.asarray, m2.params)
+
+    out = tmp_path / "ckpt"
+    acc.save_state(str(out))
+    assert (out / "model_1.safetensors").exists()
+    assert (out / "optimizer_1.bin").exists()
+
+    # Perturb both, reload, expect both restored.
+    m1.params = jax.tree.map(lambda a: a + 1.0, m1.params)
+    m2.params = jax.tree.map(lambda a: a + 1.0, m2.params)
+    acc.load_state(str(out))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6), m1.params, p1)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6), m2.params, p2)
+    assert int(np.asarray(acc._train_states[m2._state_slot].step)) == 1
