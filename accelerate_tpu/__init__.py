@@ -75,3 +75,4 @@ from .generation import (  # noqa: E402
     register_generation_plan,
     sample_logits,
 )
+from .cp_generation import cp_generate  # noqa: E402

@@ -543,6 +543,12 @@ class Accelerator:
         self._apply_activation_checkpointing(model)
         mesh = self.mesh
         cfg = self.state.parallelism_config or ParallelismConfig()
+        if (model._params if model._params is not None else model.params) is None:
+            raise RuntimeError(
+                "Model has no reachable params — it was prepared by a previous "
+                "Accelerator whose state is gone. Rebuild it (Model.from_flax "
+                "or load a checkpoint) before preparing it again."
+            )
         param_shardings = plan_parameter_sharding(
             model._params if model._params is not None else model.params,
             mesh,
@@ -613,6 +619,7 @@ class Accelerator:
             self._train_states[slot] = state
             self._slot_meta[slot] = meta
         model._state_slot = slot
+        model._accelerator = self  # bind now so prepare_model won't re-prepare
         if slot == 0:
             self._state_shardings = state_shardings
             self._param_shardings = param_shardings
@@ -690,7 +697,13 @@ class Accelerator:
         return opt_shardings
 
     def prepare_model(self, model: Model, device_placement=None, evaluation_mode: bool = False) -> Model:
-        if getattr(model, "_state_slot", None) is None:
+        if (
+            getattr(model, "_state_slot", None) is None
+            or getattr(model, "_accelerator", None) is not self
+        ):
+            # Also re-prepare a model carrying a slot from a PREVIOUS
+            # Accelerator — its stale slot index must not alias this
+            # accelerator's states (and _params may need re-materializing).
             self._prepare_state(model, None)
         model._accelerator = self
         model._params = None  # canonical copy now lives in the TrainState

@@ -359,10 +359,21 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     # optimizer_{i}.bin, restored into each slot's own sharding plan.
     for i, extra_st in enumerate(accelerator._train_states[1:], start=1):
         weights_name = f"{MODEL_NAME}_{i}.safetensors"
-        if not (
-            os.path.exists(os.path.join(input_dir, weights_name))
-            or os.path.exists(os.path.join(input_dir, weights_name + ".index.json"))
-        ):
+        have_weights = os.path.exists(os.path.join(input_dir, weights_name)) or os.path.exists(
+            os.path.join(input_dir, weights_name + ".index.json")
+        )
+        if not have_weights:
+            if os.path.exists(os.path.join(input_dir, f"{OPTIMIZER_NAME}_{i}.bin")):
+                raise FileNotFoundError(
+                    f"Checkpoint has {OPTIMIZER_NAME}_{i}.bin but no {weights_name} "
+                    f"— the save for model slot {i} was incomplete."
+                )
+            logger.warning(
+                "Checkpoint %s has no %s; model slot %d keeps its live params "
+                "(checkpoint predates this model, or a multi-model save was "
+                "interrupted).",
+                input_dir, weights_name, i,
+            )
             continue
         slot_sh = accelerator._slot_meta[i]["state_shardings"]
         flat_i = load_sharded_safetensors(input_dir, weights_name=weights_name)

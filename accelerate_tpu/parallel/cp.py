@@ -65,6 +65,7 @@ def ring_attention(
     mesh=None,
     rotate_method: Optional[str] = None,
     axis_name: str = "cp",
+    batch_axes: Optional[tuple] = ("dp_replicate", "dp_shard"),
 ):
     """Sequence-parallel attention over the ``cp`` axis.
 
@@ -82,8 +83,9 @@ def ring_attention(
         # shard_map a Mosaic kernel needs under a multi-device mesh.
         return auto_flash_attention(q, k, v, causal=causal, mesh=mesh)
 
-    # Manual SPMD region: batch over dp axes, seq over cp, heads over tp/sp.
-    qkv_spec = P(("dp_replicate", "dp_shard"), axis_name, "tp", None)
+    # Manual SPMD region: batch over dp axes (or replicated — generation's
+    # small batches pass batch_axes=()), seq over cp, heads over tp/sp.
+    qkv_spec = P(batch_axes if batch_axes else None, axis_name, "tp", None)
 
     def _local(q_c, k_c, v_c):
         idx = jax.lax.axis_index(axis_name)
