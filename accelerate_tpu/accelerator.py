@@ -1306,7 +1306,13 @@ class Accelerator:
         self._load_state_pre_hooks.append(hook)
         return _HookHandle(self._load_state_pre_hooks, hook)
 
-    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, **save_model_func_kwargs):
+    def save_state(self, output_dir: Optional[str] = None, safe_serialization: bool = True, block: bool = True, **save_model_func_kwargs):
+        """``block=False`` + ``DISTRIBUTED_STATE_DICT``: the save returns as
+        soon as device→host copies finish and bytes persist in a background
+        thread while training continues (orbax async — the step's donated
+        buffers are safe, the snapshot is already on host). Call
+        :meth:`wait_for_checkpoint` (or ``end_training``) to drain; a second
+        async save waits for the first. The reference has no async tier."""
         from .checkpointing import _checkpoint_dir, save_accelerator_state
 
         if self._save_state_pre_hooks:
@@ -1316,7 +1322,22 @@ class Accelerator:
             for hook in self._save_state_pre_hooks:
                 hook(self._models, self._train_state, resolved)
             output_dir = resolved
-        return save_accelerator_state(self, output_dir, safe_serialization=safe_serialization)
+        return save_accelerator_state(
+            self, output_dir, safe_serialization=safe_serialization, block=block
+        )
+
+    def wait_for_checkpoint(self):
+        """Block until any in-flight async checkpoint finished persisting."""
+        ckptr = getattr(self, "_async_checkpointer", None)
+        if ckptr is not None:
+            ckptr.wait_until_finished()
+
+    def _close_async_checkpointer(self):
+        ckptr = getattr(self, "_async_checkpointer", None)
+        if ckptr is not None:
+            ckptr.wait_until_finished()
+            ckptr.close()
+            self._async_checkpointer = None
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
         from .checkpointing import _checkpoint_dir, load_accelerator_state
@@ -1386,6 +1407,7 @@ class Accelerator:
                 tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self):
+        self._close_async_checkpointer()
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()
@@ -1398,6 +1420,7 @@ class Accelerator:
     def free_memory(self, *objects):
         from .utils.memory import release_memory
 
+        self._close_async_checkpointer()
         self._train_state = None
         self._state_shardings = None
         self._grad_shardings = None

@@ -115,12 +115,26 @@ def _orbax_payload(state) -> dict:
     return payload
 
 
-def _save_distributed_state(accelerator, state, output_dir: str) -> None:
+def _save_distributed_state(accelerator, state, output_dir: str, block: bool = True) -> None:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.path.join(output_dir, _ORBAX_DIR))
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _orbax_payload(state), force=True)
+    if block:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, _orbax_payload(state), force=True)
+        return
+    # Async: orbax's save blocks only until device->host copies finish, then
+    # persists to storage in a background thread — training resumes while
+    # bytes stream out (safe with donated step buffers: the snapshot is
+    # already on host). The checkpointer must outlive the call; it lives on
+    # the accelerator and wait_for_checkpoint()/end_training drain it.
+    ckptr = getattr(accelerator, "_async_checkpointer", None)
+    if ckptr is None:
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        accelerator._async_checkpointer = ckptr
+    else:
+        ckptr.wait_until_finished()  # one in-flight save at a time
+    ckptr.save(path, args=ocp.args.StandardSave(_orbax_payload(state)), force=True)
 
 
 def _load_distributed_state(accelerator, state, input_dir: str):
@@ -165,8 +179,18 @@ def _load_distributed_state(accelerator, state, input_dir: str):
     )
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+def save_accelerator_state(
+    accelerator,
+    output_dir: Optional[str] = None,
+    safe_serialization: bool = True,
+    block: bool = True,
+) -> str:
     pc = accelerator.project_configuration
+    # Any save first drains an in-flight async save: pruning below may rmtree
+    # the directory it is persisting into, and a sync save with force=True
+    # would race the background writer on the same path.
+    if hasattr(accelerator, "wait_for_checkpoint"):
+        accelerator.wait_for_checkpoint()
     output_dir = _checkpoint_dir(accelerator, output_dir)
     if pc.automatic_checkpoint_naming and accelerator.is_main_process:
         base = os.path.dirname(output_dir)
@@ -198,6 +222,14 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     # rank 0 — the pod-scale path (role of the reference's torch-DCP
     # sharded-state-dict dirs; restore reshards to whatever mesh is live).
     plugin = getattr(accelerator, "fsdp_plugin", None)
+    if block is False and not (
+        plugin is not None and plugin.state_dict_type == "DISTRIBUTED_STATE_DICT"
+    ):
+        logger.warning(
+            "save_state(block=False) is only async for "
+            "DISTRIBUTED_STATE_DICT (orbax) checkpoints; the safetensors "
+            "gather path saves synchronously."
+        )
     if plugin is not None and plugin.state_dict_type == "DISTRIBUTED_STATE_DICT":
         if len(accelerator._train_states) > 1:
             raise NotImplementedError(
@@ -205,13 +237,15 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
                 "prepared model; use FULL/SHARDED_STATE_DICT for multi-model "
                 "training runs."
             )
-        _save_distributed_state(accelerator, state, output_dir)
+        _save_distributed_state(accelerator, state, output_dir, block=block)
         _save_host_side_state(accelerator, state, output_dir)
         if pc.automatic_checkpoint_naming:
             pc.iteration += 1
         accelerator.wait_for_everyone()
         logger.info(
-            f"Saved distributed (orbax) state to {output_dir}", main_process_only=True
+            f"Saved distributed (orbax) state to {output_dir}"
+            + ("" if block else " (async: persisting in background)"),
+            main_process_only=True,
         )
         return output_dir
     max_shard = (
@@ -293,6 +327,8 @@ def _restore_loss_scale(state, input_dir: str):
 
 
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
+    if hasattr(accelerator, "wait_for_checkpoint"):
+        accelerator.wait_for_checkpoint()  # never read a half-persisted save
     input_dir = _checkpoint_dir(accelerator, input_dir, for_load=True)
     state = accelerator._train_state
     if state is None:
