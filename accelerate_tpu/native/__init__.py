@@ -97,8 +97,14 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int,
             ]
             lib.at_pread_segments.restype = ctypes.c_int
+            lib.at_pwrite_segments.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.at_pwrite_segments.restype = ctypes.c_int
             lib.at_version.restype = ctypes.c_int
-            assert lib.at_version() == 2
+            assert lib.at_version() == 3
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -236,6 +242,72 @@ def load_safetensors_fast(path: str, force: bool = False):
     if rc != 0:
         return None
     return dict(zip(names, outs))
+
+
+def _st_dtype_name(dtype: np.dtype):
+    """numpy dtype → safetensors dtype string, or None when unsupported."""
+    try:
+        import ml_dtypes
+
+        if dtype == np.dtype(ml_dtypes.bfloat16):
+            return "BF16"
+    except ImportError:
+        pass
+    for name, np_dtype in _ST_DTYPES.items():
+        if dtype == np.dtype(np_dtype):
+            return name
+    return None
+
+
+def save_safetensors_fast(state_dict, path: str, force: bool = False) -> bool:
+    """Whole-file safetensors save with parallel positioned writes — the
+    twin of :func:`load_safetensors_fast` (native/host_runtime.cpp
+    ``at_pwrite_segments``). Builds the spec header in Python (8-byte LE
+    length + JSON, space-padded so data starts 8-aligned) and fans the
+    tensor payloads over the pool with one fsync at the end. Returns False
+    when the native path can't serve the dict (no lib, unknown dtype, small
+    file) so callers fall back to the safetensors lib."""
+    import json
+
+    lib = get_lib()
+    if lib is None:
+        return False
+    arrays, header, cur = {}, {}, 0
+    for name, arr in state_dict.items():
+        arr = np.ascontiguousarray(np.asarray(arr))
+        st_name = _st_dtype_name(arr.dtype)
+        if st_name is None or arr.dtype.hasobject:
+            return False
+        arrays[name] = arr
+        header[name] = {
+            "dtype": st_name,
+            "shape": list(arr.shape),
+            "data_offsets": [cur, cur + arr.nbytes],
+        }
+        cur += arr.nbytes
+    if not force and not (_MULTICORE and cur >= NATIVE_MIN_BYTES):
+        return False
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    pad = -(8 + len(hjson)) % 8  # spec: pad with spaces, data 8-aligned
+    hjson += b" " * pad
+    blob = len(hjson).to_bytes(8, "little") + hjson
+    base = len(blob)
+    n = len(arrays)
+    if n == 0:
+        with open(path, "wb") as f:
+            f.write(blob)
+        return True
+    outs = list(arrays.values())
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in outs])
+    offs = np.ascontiguousarray(
+        [base + header[k]["data_offsets"][0] for k in arrays], dtype=np.int64
+    )
+    sizes = np.ascontiguousarray([a.nbytes for a in outs], dtype=np.int64)
+    rc = lib.at_pwrite_segments(
+        os.fsencode(path), blob, len(blob), offs.ctypes.data, sizes.ctypes.data,
+        srcs, n, _NUM_THREADS,
+    )
+    return rc == 0
 
 
 def stack_items(items: list, force: bool = False) -> np.ndarray:

@@ -160,7 +160,7 @@ void at_gather_columns(const char** srcs, const int64_t* row_bytes,
   });
 }
 
-int at_version() { return 2; }
+int at_version() { return 3; }
 
 }  // extern "C"
 
@@ -196,6 +196,67 @@ int at_pread_segments(const char* path, const int64_t* offsets,
       }
     }
   });
+  ::close(fd);
+  return status.load();
+}
+
+// Parallel positioned writes — the save-side twin of at_pread_segments
+// (checkpoint export: one safetensors shard, hundreds of tensor payloads,
+// page-cache memcpy-bound). Creates/truncates `path`, writes `header` at
+// offset 0, then fans the payload segments over the pool. fsync before
+// close so a returned 0 means bytes reached storage. Returns 0 on success,
+// -errno of the first failure otherwise.
+int at_pwrite_segments(const char* path, const char* header,
+                       int64_t header_len, const int64_t* offsets,
+                       const int64_t* sizes, const char** srcs, int64_t n,
+                       int nthreads) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  int64_t done = 0;
+  while (done < header_len) {
+    ssize_t r = ::pwrite(fd, header + done, header_len - done, done);
+    if (r <= 0) {
+      int err = r < 0 ? errno : EIO;
+      ::close(fd);
+      return -err;
+    }
+    done += r;
+  }
+  std::atomic<int> status{0};
+  // Dedicated one-shot threads, NOT the shared pool: pwrites block on disk
+  // under writeback throttling, and the pool serializes ParallelFor calls —
+  // a multi-GB checkpoint write would stall the data-loading gathers that
+  // share it. Writes are storage-bound; thread-spawn cost is noise.
+  {
+    std::atomic<int64_t> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n || status.load() != 0) return;
+        int64_t w = 0;
+        while (w < sizes[i]) {
+          ssize_t r = ::pwrite(fd, srcs[i] + w, sizes[i] - w, offsets[i] + w);
+          if (r <= 0) {
+            int err = r < 0 ? errno : EIO;
+            int expected = 0;
+            status.compare_exchange_strong(expected, -err);
+            return;
+          }
+          w += r;
+        }
+      }
+    };
+    int nw = static_cast<int>(std::min<int64_t>(std::max(1, nthreads), n));
+    std::vector<std::thread> threads;
+    threads.reserve(nw - 1);
+    for (int t = 1; t < nw; ++t) threads.emplace_back(worker);
+    worker();
+    for (auto& th : threads) th.join();
+  }
+  if (::fsync(fd) != 0) {
+    int expected = 0;
+    status.compare_exchange_strong(expected, -errno);
+  }
   ::close(fd);
   return status.load();
 }

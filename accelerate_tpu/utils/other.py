@@ -71,13 +71,20 @@ def unflatten_state_dict(flat: Mapping[str, Any], sep: str = "/") -> dict:
 # ---------------------------------------------------------------------------
 
 def save_safetensors(state_dict: Mapping[str, np.ndarray], path: str):
-    from safetensors.numpy import save_file
+    from ..native import save_safetensors_fast
 
     # ascontiguousarray is load-bearing: on TPU np.asarray of a device array
     # can be a non-C-contiguous view (the device's tiled layout exposed as
     # strides), and safetensors serializes the raw buffer without honoring
     # strides — silently corrupting every such tensor on disk.
-    save_file({k: np.ascontiguousarray(np.asarray(v)) for k, v in state_dict.items()}, path)
+    host = {k: np.ascontiguousarray(np.asarray(v)) for k, v in state_dict.items()}
+    # Parallel-pwrite native writer for big files (native/host_runtime.cpp
+    # at_pwrite_segments); safetensors lib otherwise.
+    if save_safetensors_fast(host, path):
+        return
+    from safetensors.numpy import save_file
+
+    save_file(host, path)
 
 
 def load_safetensors(path: str) -> dict[str, np.ndarray]:

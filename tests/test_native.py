@@ -175,6 +175,7 @@ def test_dispatcher_disables_prefetch_multiprocess():
     assert dl.prefetch_size == 0  # explicit opt-out plumbs through
 
 
+@requires_lib
 def test_load_safetensors_fast_matches_library(tmp_path):
     """Native parallel pread loader == safetensors lib, all dtypes incl bf16."""
     import ml_dtypes
@@ -205,3 +206,53 @@ def test_load_safetensors_fast_missing_file():
     from accelerate_tpu.native import load_safetensors_fast
 
     assert load_safetensors_fast("/nonexistent/x.safetensors", force=True) is None
+
+
+@requires_lib
+def test_save_safetensors_fast_roundtrips(tmp_path):
+    """Native parallel pwrite writer: the safetensors lib AND the native
+    reader both load it back bit-exact, all dtypes incl bf16."""
+    import ml_dtypes
+    from safetensors.numpy import load_file
+
+    from accelerate_tpu.native import load_safetensors_fast, save_safetensors_fast
+
+    rng = np.random.default_rng(1)
+    tensors = {
+        "a/f32": rng.normal(size=(64, 128)).astype(np.float32),
+        "b/bf16": rng.normal(size=(32, 16)).astype(ml_dtypes.bfloat16),
+        "c/i64": rng.integers(-5, 5, size=(9,)).astype(np.int64),
+        "d/bool": np.asarray([True, False, True]),
+    }
+    path = str(tmp_path / "w.safetensors")
+    assert save_safetensors_fast(tensors, path, force=True)
+    via_lib = load_file(path)
+    via_native = load_safetensors_fast(path, force=True)
+    for k in tensors:
+        for out in (via_lib, via_native):
+            assert out[k].dtype == tensors[k].dtype, k
+            np.testing.assert_array_equal(
+                out[k].view(np.uint8), tensors[k].view(np.uint8), err_msg=k
+            )
+
+
+@requires_lib
+def test_save_safetensors_fast_rejects_object_dtype(tmp_path):
+    from accelerate_tpu.native import save_safetensors_fast
+
+    bad = {"x": np.asarray([object()], dtype=object)}
+    assert save_safetensors_fast(bad, str(tmp_path / "bad.safetensors"), force=True) is False
+
+
+def test_save_safetensors_unified_path_uses_writer(tmp_path):
+    """utils.other.save_safetensors round-trips through whichever path the
+    size gate picks."""
+    from safetensors.numpy import load_file
+
+    from accelerate_tpu.utils.other import save_safetensors
+
+    big = {"w": np.arange(2**18, dtype=np.float32).reshape(512, 512)}
+    path = str(tmp_path / "u.safetensors")
+    save_safetensors(big, path)
+    out = load_file(path)
+    np.testing.assert_array_equal(out["w"], big["w"])
