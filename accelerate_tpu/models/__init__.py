@@ -76,6 +76,14 @@ from .whisper import (
     WhisperForConditionalGeneration,
     whisper_tp_rules,
 )
+from .clip import (
+    CLIPConfig,
+    CLIPModel,
+    CLIPTextModel,
+    CLIPVisionModel,
+    clip_contrastive_loss,
+    clip_tp_rules,
+)
 from .megatron import (
     load_megatron_checkpoint,
     megatron_config_from_args,

@@ -1,0 +1,319 @@
+"""CLIP — contrastive image-text dual encoder, TPU-first.
+
+Same design points as the other families (models/vit.py, models/gpt2.py):
+fused per-head DenseGeneral projections shaped for the MXU, ``nn.scan`` over
+identical blocks per tower, optional remat, Megatron-style TP rule table,
+bf16 compute with fp32 params. One shared encoder block serves both towers
+(text runs it causal, vision bidirectional — the actual CLIP architecture).
+HF ``CLIPModel`` checkpoints load via models/hub.py with tested embedding
+and logit parity.
+
+Reference context: the reference framework trains/serves CLIP through
+transformers + torch; here it is a native family like the rest of the zoo
+(reference: big_modeling/device_map docs use CLIP-style dual encoders as
+multimodal examples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class CLIPConfig:
+    # Text tower (defaults: openai/clip-vit-base-patch32)
+    vocab_size: int = 49408
+    text_hidden_size: int = 512
+    text_num_layers: int = 12
+    text_num_heads: int = 8
+    text_intermediate_size: int = 2048
+    max_position_embeddings: int = 77
+    # Vision tower
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    vision_hidden_size: int = 768
+    vision_num_layers: int = 12
+    vision_num_heads: int = 12
+    vision_intermediate_size: int = 3072
+    # Joint space
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592  # ln(1/0.07), the CLIP paper value
+    layer_norm_eps: float = 1e-5
+    # Text pooling convention (transformers parity): eos_token_id == 2 means
+    # the legacy "EOT carries the largest id" argmax pooling; any other value
+    # pools at the FIRST position equal to it (HF PR #24773 semantics).
+    eos_token_id: int = 49407
+    hidden_act: str = "quick_gelu"  # both towers; gelu for LAION-style checkpoints
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=512, text_hidden_size=32, text_num_layers=2,
+            text_num_heads=2, text_intermediate_size=64,
+            max_position_embeddings=16, image_size=32, patch_size=8,
+            vision_hidden_size=48, vision_num_layers=2, vision_num_heads=2,
+            vision_intermediate_size=96, projection_dim=24,
+            eos_token_id=2,  # legacy argmax pooling — pairs with tests' max-id-last ids
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def quick_gelu(x):
+    """CLIP's activation: x * sigmoid(1.702 x)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTIVATIONS = {
+    "quick_gelu": quick_gelu,
+    "gelu": partial(nn.gelu, approximate=False),
+    "gelu_new": partial(nn.gelu, approximate=True),
+    "gelu_pytorch_tanh": partial(nn.gelu, approximate=True),
+}
+
+
+def _activation(name: str):
+    if name not in _ACTIVATIONS:
+        raise ValueError(
+            f"Unsupported CLIP hidden_act {name!r}; supported: {sorted(_ACTIVATIONS)}"
+        )
+    return _ACTIVATIONS[name]
+
+
+class CLIPAttention(nn.Module):
+    config: CLIPConfig
+    hidden: int
+    heads: int
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = self.hidden // self.heads
+        dense = partial(
+            nn.DenseGeneral, features=(self.heads, d), dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+        )
+        q = dense(name="q_proj")(x)
+        k = dense(name="k_proj")(x)
+        v = dense(name="v_proj")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(cfg.dtype)
+        if self.causal:
+            s = x.shape[1]
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            features=self.hidden, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="out_proj",
+        )(out)
+
+
+class CLIPBlock(nn.Module):
+    """Pre-LN encoder block, quick-GELU MLP — shared by both towers."""
+
+    config: CLIPConfig
+    hidden: int
+    heads: int
+    intermediate: int
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln1")(x)
+        x = x + CLIPAttention(
+            cfg, self.hidden, self.heads, self.causal, name="self_attn"
+        )(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln2")(x)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = _activation(cfg.hidden_act)(dense(self.intermediate, name="fc1")(h))
+        return x + dense(self.hidden, name="fc2")(h)
+
+
+class _ScannedCLIPBlock(nn.Module):
+    config: CLIPConfig
+    hidden: int
+    heads: int
+    intermediate: int
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, _):
+        return CLIPBlock(
+            self.config, self.hidden, self.heads, self.intermediate,
+            self.causal, name="block",
+        )(x), None
+
+
+def _encoder(cfg: CLIPConfig, x, *, hidden, heads, intermediate, causal, n_layers):
+    block_cls = _ScannedCLIPBlock
+    if cfg.remat:
+        block_cls = nn.remat(block_cls, prevent_cse=False)
+    if cfg.scan_layers:
+        scanned = nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        x, _ = scanned(cfg, hidden, heads, intermediate, causal, name="layers")(x, None)
+        return x
+    blk = nn.remat(CLIPBlock, prevent_cse=False) if cfg.remat else CLIPBlock
+    for i in range(n_layers):
+        x = blk(cfg, hidden, heads, intermediate, causal, name=f"layer_{i}")(x)
+    return x
+
+
+class CLIPTextModel(nn.Module):
+    config: CLIPConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        """input_ids (B, S) → (last_hidden (B,S,H), pooled (B,H)). Pooled is
+        the EOT-token feature — CLIP's convention that the EOT token carries
+        the largest id in the sequence (argmax over ids)."""
+        cfg = self.config
+        tok = self.param(
+            "token_embedding", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.text_hidden_size), jnp.float32,
+        )
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(0.01),
+            (cfg.max_position_embeddings, cfg.text_hidden_size), jnp.float32,
+        )
+        s = input_ids.shape[1]
+        x = jnp.take(tok, input_ids, axis=0).astype(cfg.dtype)
+        x = x + pos[None, :s].astype(cfg.dtype)
+        x = _encoder(
+            cfg, x, hidden=cfg.text_hidden_size, heads=cfg.text_num_heads,
+            intermediate=cfg.text_intermediate_size, causal=True,
+            n_layers=cfg.text_num_layers,
+        )
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_ln")(x)
+        if cfg.eos_token_id == 2:
+            eot = jnp.argmax(input_ids, axis=-1)  # legacy: EOT = largest id
+        else:
+            eot = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+        pooled = jnp.take_along_axis(x, eot[:, None, None].repeat(x.shape[-1], -1), 1)[:, 0]
+        return x, pooled
+
+
+class CLIPVisionModel(nn.Module):
+    config: CLIPConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        """pixel_values (B, H, W, C) NHWC → (last_hidden, pooled (CLS))."""
+        cfg = self.config
+        x = nn.Conv(
+            cfg.vision_hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+            use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="patch_embed",
+        )(pixel_values.astype(cfg.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.vision_hidden_size)
+        cls = self.param(
+            "class_embedding", nn.initializers.normal(0.02),
+            (cfg.vision_hidden_size,), jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (b, 1, cfg.vision_hidden_size)), x], 1
+        )
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(0.02),
+            (cfg.num_patches + 1, cfg.vision_hidden_size), jnp.float32,
+        )
+        x = x + pos[None].astype(x.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="pre_ln")(x)
+        x = _encoder(
+            cfg, x, hidden=cfg.vision_hidden_size, heads=cfg.vision_num_heads,
+            intermediate=cfg.vision_intermediate_size, causal=False,
+            n_layers=cfg.vision_num_layers,
+        )
+        pooled = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_ln")(x[:, 0])
+        return x, pooled
+
+
+class CLIPModel(nn.Module):
+    """Dual encoder: returns (logits_per_image, logits_per_text,
+    image_embeds, text_embeds) like transformers' CLIPModel."""
+
+    config: CLIPConfig
+
+    def setup(self):
+        cfg = self.config
+        self.text_model = CLIPTextModel(cfg, name="text")
+        self.vision_model = CLIPVisionModel(cfg, name="vision")
+        self.text_projection = nn.Dense(
+            cfg.projection_dim, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="text_projection",
+        )
+        self.visual_projection = nn.Dense(
+            cfg.projection_dim, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="visual_projection",
+        )
+        self.logit_scale = self.param(
+            "logit_scale",
+            lambda *_: jnp.asarray(cfg.logit_scale_init, jnp.float32),
+        )
+
+    def encode_text(self, input_ids):
+        _, pooled = self.text_model(input_ids)
+        return self.text_projection(pooled.astype(jnp.float32))
+
+    def encode_image(self, pixel_values):
+        _, pooled = self.vision_model(pixel_values)
+        return self.visual_projection(pooled.astype(jnp.float32))
+
+    def __call__(self, input_ids, pixel_values):
+        text_embeds = self.encode_text(input_ids)
+        image_embeds = self.encode_image(pixel_values)
+        # transformers parity: the returned embeds are the NORMALIZED features
+        # (CLIPModel.forward normalizes before building the logits and puts
+        # the normalized vectors in its output struct).
+        text_embeds = text_embeds / jnp.linalg.norm(text_embeds, axis=-1, keepdims=True)
+        image_embeds = image_embeds / jnp.linalg.norm(image_embeds, axis=-1, keepdims=True)
+        logits_per_text = jnp.exp(self.logit_scale) * text_embeds @ image_embeds.T
+        return logits_per_text.T, logits_per_text, image_embeds, text_embeds
+
+
+def clip_contrastive_loss(module, params, input_ids, pixel_values):
+    """Symmetric InfoNCE over the in-batch similarity matrix — the CLIP
+    training objective (diagonal = matched pairs)."""
+    logits_per_image, logits_per_text, _, _ = module.apply(
+        {"params": params}, input_ids, pixel_values
+    )
+    labels = jnp.arange(logits_per_image.shape[0])
+    li = -jnp.mean(jax.nn.log_softmax(logits_per_image, -1)[labels, labels])
+    lt = -jnp.mean(jax.nn.log_softmax(logits_per_text, -1)[labels, labels])
+    return (li + lt) / 2
+
+
+def clip_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:
+    """Megatron column/row table for both towers (same shape as ViT/BERT)."""
+    lead = (None,) if scan_layers else ()
+    return [
+        (r"self_attn/(q_proj|k_proj|v_proj)/kernel", lead + (None, "tp", None)),
+        (r"self_attn/out_proj/kernel", lead + ("tp", None, None)),
+        (r"fc1/kernel", lead + (None, "tp")),
+        (r"fc2/kernel", lead + ("tp", None)),
+    ]

@@ -725,10 +725,123 @@ def t5_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# CLIP
+# ---------------------------------------------------------------------------
+
+def clip_config_from_hf(hf: Any) -> "CLIPConfig":
+    from .clip import CLIPConfig
+
+    if isinstance(hf, dict):
+        text, vision = hf.get("text_config", {}), hf.get("vision_config", {})
+        tg = lambda k, d=None: text.get(k, d)  # noqa: E731
+        vg = lambda k, d=None: vision.get(k, d)  # noqa: E731
+        g = lambda k, d=None: hf.get(k, d)  # noqa: E731
+    else:
+        tg = lambda k, d=None: getattr(hf.text_config, k, d)  # noqa: E731
+        vg = lambda k, d=None: getattr(hf.vision_config, k, d)  # noqa: E731
+        g = lambda k, d=None: getattr(hf, k, d)  # noqa: E731
+    return CLIPConfig(
+        vocab_size=tg("vocab_size"),
+        text_hidden_size=tg("hidden_size"),
+        text_num_layers=tg("num_hidden_layers"),
+        text_num_heads=tg("num_attention_heads"),
+        text_intermediate_size=tg("intermediate_size"),
+        max_position_embeddings=tg("max_position_embeddings", 77),
+        image_size=vg("image_size", 224),
+        patch_size=vg("patch_size", 32),
+        num_channels=vg("num_channels", 3),
+        vision_hidden_size=vg("hidden_size"),
+        vision_num_layers=vg("num_hidden_layers"),
+        vision_num_heads=vg("num_attention_heads"),
+        vision_intermediate_size=vg("intermediate_size"),
+        projection_dim=g("projection_dim", 512),
+        logit_scale_init=g("logit_scale_init_value", 2.6592),
+        layer_norm_eps=tg("layer_norm_eps", 1e-5),
+        eos_token_id=tg("eos_token_id", 49407),
+        hidden_act=_clip_hidden_act(tg, vg),
+    )
+
+
+def _clip_hidden_act(tg, vg) -> str:
+    text_act = tg("hidden_act", "quick_gelu")
+    vision_act = vg("hidden_act", "quick_gelu")
+    if text_act != vision_act:
+        raise ValueError(
+            f"CLIP checkpoint mixes tower activations (text={text_act!r}, "
+            f"vision={vision_act!r}) — not supported by the native family."
+        )
+    return text_act
+
+
+def _clip_tower_layers(sd, prefix, n, h, nh):
+    d = h // nh
+    layers = []
+    for i in range(n):
+        p = f"{prefix}.encoder.layers.{i}."
+        layers.append({
+            "ln1/scale": _np(sd[p + "layer_norm1.weight"]),
+            "ln1/bias": _np(sd[p + "layer_norm1.bias"]),
+            "self_attn/q_proj/kernel": _t(sd[p + "self_attn.q_proj.weight"]).reshape(h, nh, d),
+            "self_attn/q_proj/bias": _np(sd[p + "self_attn.q_proj.bias"]).reshape(nh, d),
+            "self_attn/k_proj/kernel": _t(sd[p + "self_attn.k_proj.weight"]).reshape(h, nh, d),
+            "self_attn/k_proj/bias": _np(sd[p + "self_attn.k_proj.bias"]).reshape(nh, d),
+            "self_attn/v_proj/kernel": _t(sd[p + "self_attn.v_proj.weight"]).reshape(h, nh, d),
+            "self_attn/v_proj/bias": _np(sd[p + "self_attn.v_proj.bias"]).reshape(nh, d),
+            "self_attn/out_proj/kernel": _t(sd[p + "self_attn.out_proj.weight"]).reshape(nh, d, h),
+            "self_attn/out_proj/bias": _np(sd[p + "self_attn.out_proj.bias"]),
+            "ln2/scale": _np(sd[p + "layer_norm2.weight"]),
+            "ln2/bias": _np(sd[p + "layer_norm2.bias"]),
+            "fc1/kernel": _t(sd[p + "mlp.fc1.weight"]),
+            "fc1/bias": _np(sd[p + "mlp.fc1.bias"]),
+            "fc2/kernel": _t(sd[p + "mlp.fc2.weight"]),
+            "fc2/bias": _np(sd[p + "mlp.fc2.bias"]),
+        })
+    return layers
+
+
+def clip_params_from_hf(cfg, sd: dict) -> dict:
+    tree: dict = {"text": {}, "vision": {}}
+    # Text tower
+    _set(tree, "text/token_embedding", _np(sd["text_model.embeddings.token_embedding.weight"]))
+    _set(tree, "text/position_embedding", _np(sd["text_model.embeddings.position_embedding.weight"]))
+    _set(tree, "text/final_ln/scale", _np(sd["text_model.final_layer_norm.weight"]))
+    _set(tree, "text/final_ln/bias", _np(sd["text_model.final_layer_norm.bias"]))
+    _place_layers(
+        tree,
+        _stack_layers(_clip_tower_layers(
+            sd, "text_model", cfg.text_num_layers, cfg.text_hidden_size, cfg.text_num_heads
+        )),
+        cfg.scan_layers, "text/layers/block", "text/layer_{i}", cfg.text_num_layers,
+    )
+    # Vision tower (note: HF spells it "pre_layrnorm")
+    _set(tree, "vision/class_embedding", _np(sd["vision_model.embeddings.class_embedding"]))
+    conv = _np(sd["vision_model.embeddings.patch_embedding.weight"]).transpose(2, 3, 1, 0)
+    _set(tree, "vision/patch_embed/kernel", conv)
+    _set(tree, "vision/position_embedding", _np(sd["vision_model.embeddings.position_embedding.weight"]))
+    _set(tree, "vision/pre_ln/scale", _np(sd["vision_model.pre_layrnorm.weight"]))
+    _set(tree, "vision/pre_ln/bias", _np(sd["vision_model.pre_layrnorm.bias"]))
+    _set(tree, "vision/post_ln/scale", _np(sd["vision_model.post_layernorm.weight"]))
+    _set(tree, "vision/post_ln/bias", _np(sd["vision_model.post_layernorm.bias"]))
+    _place_layers(
+        tree,
+        _stack_layers(_clip_tower_layers(
+            sd, "vision_model", cfg.vision_num_layers, cfg.vision_hidden_size,
+            cfg.vision_num_heads,
+        )),
+        cfg.scan_layers, "vision/layers/block", "vision/layer_{i}", cfg.vision_num_layers,
+    )
+    _set(tree, "text_projection/kernel", _t(sd["text_projection.weight"]))
+    _set(tree, "visual_projection/kernel", _t(sd["visual_projection.weight"]))
+    _set(tree, "logit_scale", _np(sd["logit_scale"]))
+    return tree
+
+
+# ---------------------------------------------------------------------------
 # High-level entry
 # ---------------------------------------------------------------------------
 
 _FAMILIES = {
+    "clip": ("CLIPModel", clip_config_from_hf, clip_params_from_hf),
     "llama": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "mistral": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "qwen2": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),

@@ -1,0 +1,126 @@
+"""CLIP family: shapes, contrastive loss trains, TP sharding, HF parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Model
+from accelerate_tpu.models import (
+    CLIPConfig,
+    CLIPModel,
+    clip_contrastive_loss,
+    clip_tp_rules,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def _batch(n=4, cfg=None, seed=0):
+    cfg = cfg or CLIPConfig.tiny()
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, cfg.vocab_size, size=(n, cfg.max_position_embeddings // 2))
+    # EOT convention: pooled feature reads the max-id position; force it last.
+    ids[:, -1] = cfg.vocab_size - 1
+    imgs = rng.normal(size=(n, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    return jnp.asarray(ids, jnp.int32), jnp.asarray(imgs)
+
+
+def test_clip_forward_shapes():
+    set_seed(0)
+    cfg = CLIPConfig.tiny(dtype=jnp.float32)
+    module = CLIPModel(cfg)
+    ids, imgs = _batch(3, cfg)
+    variables = module.init(jax.random.key(0), ids, imgs)
+    lpi, lpt, img_e, txt_e = module.apply(variables, ids, imgs)
+    assert lpi.shape == (3, 3) and lpt.shape == (3, 3)
+    assert img_e.shape == (3, cfg.projection_dim)
+    assert txt_e.shape == (3, cfg.projection_dim)
+    np.testing.assert_allclose(np.asarray(lpi), np.asarray(lpt).T, rtol=1e-6)
+
+
+def test_clip_contrastive_training_decreases_loss():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    set_seed(0)
+    cfg = CLIPConfig.tiny(dtype=jnp.float32)
+    module = CLIPModel(cfg)
+    ids, imgs = _batch(8, cfg)
+    acc = Accelerator()
+    model = Model.from_flax(module, jax.random.key(0), ids, imgs)
+    model, _ = acc.prepare(model, optax.adam(1e-3))
+
+    def loss_fn(params, batch):
+        return clip_contrastive_loss(module, params, batch["ids"], batch["imgs"])
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    first = None
+    for _ in range(8):
+        state, metrics = step(state, {"ids": ids, "imgs": imgs})
+        first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+
+
+def test_clip_tp_sharded_embeds_match():
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    set_seed(0)
+    cfg = CLIPConfig.tiny(dtype=jnp.float32)
+    module = CLIPModel(cfg)
+    ids, imgs = _batch(4, cfg)
+    single = Model.from_flax(module, jax.random.key(0), ids, imgs)
+    _, _, want_img, want_txt = single(ids, imgs)
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(tp_size=2, dp_shard_size=4))
+    model = Model.from_flax(module, jax.random.key(0), ids, imgs, tp_rules=clip_tp_rules())
+    model, _ = acc.prepare(model, optax.adam(1e-3))
+    _, _, got_img, got_txt = model(ids, imgs)
+    np.testing.assert_allclose(np.asarray(got_img), np.asarray(want_img), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_txt), np.asarray(want_txt), rtol=2e-4, atol=2e-4)
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+
+
+def test_clip_hf_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from accelerate_tpu.models import model_from_pretrained
+
+    hf_cfg = transformers.CLIPConfig(
+        text_config_dict=dict(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=16, eos_token_id=98,
+        ),
+        vision_config_dict=dict(
+            image_size=32, patch_size=8, hidden_size=48, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=96,
+        ),
+        projection_dim=24,
+    )
+    torch.manual_seed(0)
+    hf = transformers.CLIPModel(hf_cfg)
+    hf.eval()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 98, size=(2, 12)).astype(np.int64)
+    ids[:, -1] = 98  # EOT = max id
+    imgs = rng.normal(size=(2, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids), pixel_values=torch.from_numpy(imgs))
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    lpi, lpt, img_e, txt_e = ours(jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(imgs.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(img_e), out.image_embeds.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(txt_e), out.text_embeds.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(lpi), out.logits_per_image.numpy(), rtol=2e-4, atol=2e-4
+    )
