@@ -756,10 +756,21 @@ def clip_config_from_hf(hf: Any) -> "CLIPConfig":
         vision_intermediate_size=vg("intermediate_size"),
         projection_dim=g("projection_dim", 512),
         logit_scale_init=g("logit_scale_init_value", 2.6592),
-        layer_norm_eps=tg("layer_norm_eps", 1e-5),
+        layer_norm_eps=_clip_ln_eps(tg, vg),
         eos_token_id=tg("eos_token_id", 49407),
         hidden_act=_clip_hidden_act(tg, vg),
     )
+
+
+def _clip_ln_eps(tg, vg) -> float:
+    text_eps = tg("layer_norm_eps", 1e-5)
+    vision_eps = vg("layer_norm_eps", 1e-5)
+    if text_eps != vision_eps:
+        raise ValueError(
+            f"CLIP checkpoint mixes tower layer_norm_eps (text={text_eps}, "
+            f"vision={vision_eps}) — not supported by the native family."
+        )
+    return text_eps
 
 
 def _clip_hidden_act(tg, vg) -> str:

@@ -69,5 +69,32 @@ resumed = jax.tree.map(np.asarray, state2.params)
 for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed)):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
+# Multi-model save/load under a real process group: the extra-slot gathers
+# are collectives every rank must enter (round-3 regression — gathering only
+# on rank 0 deadlocked multi-host saves).
+AcceleratorState._reset_state()
+GradientState._reset_state()
+set_seed(0)
+acc3 = Accelerator()
+m_a = Model.from_flax(module, jax.random.key(1), np.zeros((4,), np.float32))
+m_b = Model.from_flax(module, jax.random.key(2), np.zeros((4,), np.float32))
+m_a, _, m_b, _ = acc3.prepare(m_a, optax.adam(1e-2), m_b, optax.adam(1e-2))
+step_a = acc3.prepare_train_step(loss_fn, model=m_a)
+step_b = acc3.prepare_train_step(loss_fn, model=m_b)
+sa = acc3._train_states[m_a._state_slot]
+sb = acc3._train_states[m_b._state_slot]
+sa, _ = step_a(sa, batch)
+sb, _ = step_b(sb, batch)
+want_b = jax.tree.map(np.asarray, m_b.params)
+
+payload = [tempfile.mkdtemp() if rank == 0 else None]
+broadcast_object_list(payload, from_process=0)
+ckpt2 = payload[0]
+acc3.save_state(ckpt2)
+m_b.params = jax.tree.map(lambda p: p * 0, m_b.params)
+acc3.load_state(ckpt2)
+for a, b in zip(jax.tree.leaves(want_b), jax.tree.leaves(jax.tree.map(np.asarray, m_b.params))):
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
 if acc.is_main_process:
     print("TEST_CHECKPOINTING OK")

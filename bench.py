@@ -302,7 +302,15 @@ def _backend_probe(timeout_s: int = 90) -> tuple[bool, str]:
     relay-down signature, worth waiting out) and the probe's stderr for a
     fast deterministic failure (broken install — NOT worth waiting out).
     """
-    probe = "import jax; jax.devices(); print('ok')"
+    # Same knob the child re-asserts: the axon site hook pins jax_platforms
+    # at interpreter start, so an env request (CPU smoke runs) must go
+    # through jax.config or the probe hangs on a dead relay it was told to
+    # avoid.
+    probe = (
+        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "jax.devices(); print('ok')"
+    )
     try:
         r = subprocess.run(
             [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
