@@ -570,12 +570,14 @@ class Accelerator:
                     **{k: v for k, v in kw.items() if k in ("growth_factor", "backoff_factor", "growth_interval")},
                 )
         if tx is not None:
-            opt_shardings = self._build_opt_shardings(model, params, param_shardings, tx, cfg)
+            opt_shardings, grad_shardings, opt_offload = self._build_opt_shardings(
+                model, params, param_shardings, tx, cfg
+            )
             opt_init = jax.jit(tx.init, out_shardings=opt_shardings)
             opt_state = opt_init(params)
         else:
             opt_state, opt_shardings = (), ()
-            self._opt_offload = None
+            grad_shardings, opt_offload = None, None
         extra = model.extra_state
         extra_shardings = jax.tree.map(lambda _: replicated(mesh), extra) if extra else None
         state = TrainState(
@@ -599,14 +601,13 @@ class Accelerator:
             apply_fn=model.apply_fn,
             tx=tx,
         )
-        # Commit into this model's slot. _plan_opt_shardings/_build_opt_shardings
-        # recorded their results in the flat attrs; snapshot them per-slot,
-        # then restore the flat attrs to slot 0's plans (the legacy surface).
+        # Commit into this model's slot; the flat attrs mirror slot 0 (the
+        # legacy single-model surface).
         meta = {
             "state_shardings": state_shardings,
             "param_shardings": param_shardings,
-            "grad_shardings": self._grad_shardings,
-            "opt_offload": self._opt_offload,
+            "grad_shardings": grad_shardings,
+            "opt_offload": opt_offload,
         }
         slot = getattr(model, "_state_slot", None)
         if getattr(model, "_accelerator", None) is not None and model._accelerator is not self:
@@ -623,10 +624,8 @@ class Accelerator:
         if slot == 0:
             self._state_shardings = state_shardings
             self._param_shardings = param_shardings
-        else:
-            primary = self._slot_meta[0]
-            self._grad_shardings = primary["grad_shardings"]
-            self._opt_offload = primary["opt_offload"]
+            self._grad_shardings = grad_shardings
+            self._opt_offload = opt_offload
 
     def _plan_opt_shardings(self, model, param_shardings, mesh, cfg):
         """ZeRO-1/2 (SHARD_GRAD_OP) + cpu_offload planning.
@@ -645,11 +644,11 @@ class Accelerator:
         ``pinned_host`` memory — XLA's host-offload path streams it per update
         instead of the reference's CPUOffload module wrapper.
 
-        Returns (opt sharding plan tree, memory_kind or None) and records the
-        gradient sharding constraint for prepare_train_step (the ZeRO-2
-        reduce-scatter)."""
+        Pure planner: returns (opt sharding plan tree, memory_kind or None,
+        grad shardings or None — the ZeRO-2 reduce-scatter constraint for
+        prepare_train_step). Callers commit the plans into the slot meta."""
         plugin = self.fsdp_plugin
-        self._grad_shardings = None
+        grad_shardings = None
         opt_plan = param_shardings
         if plugin is not None and plugin.shards_grads_and_opt and not plugin.shards_params:
             params_tree = model._params if model._params is not None else model.params
@@ -661,7 +660,7 @@ class Accelerator:
                 tp_rules=model.tp_rules,
                 shards_params_override=True,
             )
-            self._grad_shardings = opt_plan
+            grad_shardings = opt_plan
         mem_kind = None
         if plugin is not None and plugin.cpu_offload:
             # Host offload is a TPU-runtime feature; the CPU backend accepts
@@ -675,14 +674,17 @@ class Accelerator:
                     "host memory space — optimizer state stays in device memory.",
                     self.device.platform,
                 )
-        return opt_plan, mem_kind
+        return opt_plan, mem_kind, grad_shardings
 
     def _build_opt_shardings(self, model, params, param_shardings, tx, cfg):
         """Shared by _prepare_state and prepare_optimizer: plan optimizer-state
-        shardings (ZeRO strategy + cpu_offload) and record ``_opt_offload``
-        for the fused step. Returns the storage shardings (host-pinned under
-        cpu_offload)."""
-        opt_plan, mem_kind = self._plan_opt_shardings(model, param_shardings, self.mesh, cfg)
+        shardings (ZeRO strategy + cpu_offload). Pure: returns
+        (storage opt shardings — host-pinned under cpu_offload,
+        grad shardings or None, opt_offload pair or None); callers commit
+        them into the slot meta (flat attrs mirror slot 0 only)."""
+        opt_plan, mem_kind, grad_shardings = self._plan_opt_shardings(
+            model, param_shardings, self.mesh, cfg
+        )
         opt_shapes = jax.eval_shape(tx.init, params)
         opt_shardings = infer_opt_state_sharding(
             opt_shapes, params, opt_plan, self.mesh, memory_kind=mem_kind
@@ -691,10 +693,10 @@ class Accelerator:
             # Host-offloaded optimizer state: the fused step streams it to
             # device around tx.update (see prepare_train_step).
             device_shardings = infer_opt_state_sharding(opt_shapes, params, opt_plan, self.mesh)
-            self._opt_offload = (device_shardings, opt_shardings)
+            opt_offload = (device_shardings, opt_shardings)
         else:
-            self._opt_offload = None
-        return opt_shardings
+            opt_offload = None
+        return opt_shardings, grad_shardings, opt_offload
 
     def prepare_model(self, model: Model, device_placement=None, evaluation_mode: bool = False) -> Model:
         if (
@@ -753,14 +755,14 @@ class Accelerator:
             param_shardings = meta["param_shardings"]
             cfg = self.state.parallelism_config or ParallelismConfig()
             if model is not None:
-                opt_shardings = self._build_opt_shardings(
+                opt_shardings, grad_shardings, opt_offload = self._build_opt_shardings(
                     model, state.params, param_shardings, optimizer, cfg
                 )
-                meta["grad_shardings"] = self._grad_shardings
-                meta["opt_offload"] = self._opt_offload
-                if slot != 0:
-                    self._grad_shardings = self._slot_meta[0]["grad_shardings"]
-                    self._opt_offload = self._slot_meta[0]["opt_offload"]
+                meta["grad_shardings"] = grad_shardings
+                meta["opt_offload"] = opt_offload
+                if slot == 0:
+                    self._grad_shardings = grad_shardings
+                    self._opt_offload = opt_offload
             else:
                 opt_shapes = jax.eval_shape(optimizer.init, state.params)
                 opt_shardings = infer_opt_state_sharding(
@@ -1424,6 +1426,8 @@ class Accelerator:
         self._train_state = None
         self._state_shardings = None
         self._grad_shardings = None
+        self._param_shardings = None
+        self._opt_offload = None
         self._grad_fn_cache.clear()
         self._apply_jit = None
         self._gradnorm_jit = None
