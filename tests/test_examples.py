@@ -84,6 +84,7 @@ _BY_FEATURE_OK = {
     "schedule_free.py": "schedule_free OK",
     "cross_validation.py": "cross-validation OK",
     "fsdp_with_peak_mem_tracking.py": "fsdp peak-mem OK",
+    "long_context_generation.py": "long-context generation OK",
 }
 
 
@@ -149,6 +150,7 @@ _FEATURE_MARKERS = {
     "schedule_free.py": ["schedule_free_adamw", "schedule_free_eval_params"],
     "cross_validation.py": ["fold_split"],
     "fsdp_with_peak_mem_tracking.py": ["FullyShardedDataParallelPlugin", "memory_stats"],
+    "long_context_generation.py": ["cp_generate"],
 }
 
 
