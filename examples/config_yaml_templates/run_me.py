@@ -1,0 +1,20 @@
+"""Prints the topology the active config resolves to — run it through the
+launcher with any template (reference: config_yaml_templates/run_me.py):
+
+    accelerate-tpu launch --config_file fsdp.yaml run_me.py
+"""
+
+from accelerate_tpu import Accelerator
+
+
+def main():
+    acc = Accelerator()
+    acc.print(
+        f"processes={acc.num_processes} rank={acc.process_index} "
+        f"mesh={dict(acc.mesh.shape)} mixed_precision={acc.mixed_precision} "
+        f"fsdp={'on (' + acc.fsdp_plugin.sharding_strategy + ')' if acc.fsdp_plugin else 'off'}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/bin/bash
+# SLURM template for a multi-host TPU pod job (parity surface for
+# /root/reference/examples/slurm/submit_multinode.sh, TPU-flavored:
+# ONE task per host — a single JAX process drives all of a host's chips).
+
+#SBATCH --job-name=accelerate-tpu
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # TPU hosts in the slice
+#SBATCH --ntasks-per-node=1         # one JAX process per host (SPMD)
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+source activate_environment.sh      # your venv with accelerate-tpu
+
+######################
+#### Set network #####
+######################
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+# NOTE: \$SLURM_NODEID is escaped — it must expand inside each srun task
+# (where it is the host's index), not in this batch shell (where it is 0).
+export LAUNCHER="accelerate-tpu launch \
+    --num_processes $SLURM_NNODES \
+    --num_machines $SLURM_NNODES \
+    --machine_rank \$SLURM_NODEID \
+    --main_process_ip $head_node_ip \
+    --main_process_port 8476 \
+    --mixed_precision bf16 \
+    --use_fsdp --fsdp_sharding_strategy FULL_SHARD \
+    "
+export SCRIPT="examples/complete_nlp_example.py"
+export SCRIPT_ARGS="--epochs 3 --project_dir runs/$SLURM_JOB_ID"
+
+# srun starts one launcher per host; each brings up its local JAX process
+# and they rendezvous at the coordinator on the head node.
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"
