@@ -725,6 +725,57 @@ def t5_params_from_hf(cfg, sd: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Phi-3 (Llama architecture with fused qkv_proj / gate_up_proj)
+# ---------------------------------------------------------------------------
+
+def phi3_config_from_hf(hf: Any) -> "LlamaConfig":
+    """Llama config + guards for the Phi-3 variants the plain-RoPE Llama
+    family cannot represent: longrope scaling (Phi-3-mini-128k) and partial
+    rotary (Phi-4-mini) would convert silently and diverge at every token."""
+    g = (lambda k, d=None: hf.get(k, d)) if isinstance(hf, dict) else (
+        lambda k, d=None: getattr(hf, k, d)
+    )
+    scaling = g("rope_scaling")
+    if scaling:
+        raise ValueError(
+            f"Phi-3 checkpoint uses rope_scaling={scaling.get('type', scaling) if isinstance(scaling, dict) else scaling!r} "
+            "— longrope is not supported by the Llama family; load the base "
+            "(4k) variant instead."
+        )
+    partial = g("partial_rotary_factor", 1.0)
+    if partial not in (None, 1.0):
+        raise ValueError(
+            f"Phi-3 checkpoint uses partial_rotary_factor={partial} — the "
+            "Llama family applies full-head RoPE only."
+        )
+    return llama_config_from_hf(hf)
+
+
+def phi3_params_from_hf(cfg, sd: dict) -> dict:
+    """Split Phi-3's fused projections into the Llama family's layout:
+    qkv_proj rows are [q (Hq·d) | k (Hkv·d) | v (Hkv·d)], gate_up_proj rows
+    are [gate (I) | up (I)]; everything else is byte-identical Llama."""
+    q_rows = cfg.num_attention_heads * cfg.head_dim
+    kv_rows = cfg.num_key_value_heads * cfg.head_dim
+    split: dict = {}
+    for k, v in sd.items():
+        if k.endswith("self_attn.qkv_proj.weight"):
+            base = k[: -len("qkv_proj.weight")]
+            w = _np(v)
+            split[base + "q_proj.weight"] = w[:q_rows]
+            split[base + "k_proj.weight"] = w[q_rows:q_rows + kv_rows]
+            split[base + "v_proj.weight"] = w[q_rows + kv_rows:]
+        elif k.endswith("mlp.gate_up_proj.weight"):
+            base = k[: -len("gate_up_proj.weight")]
+            w = _np(v)
+            split[base + "gate_proj.weight"] = w[: cfg.intermediate_size]
+            split[base + "up_proj.weight"] = w[cfg.intermediate_size:]
+        else:
+            split[k] = v
+    return llama_params_from_hf(cfg, split)
+
+
+# ---------------------------------------------------------------------------
 # CLIP
 # ---------------------------------------------------------------------------
 
@@ -857,6 +908,7 @@ _FAMILIES = {
     "mistral": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "qwen2": ("LlamaForCausalLM", llama_config_from_hf, llama_params_from_hf),
     "gemma": ("LlamaForCausalLM", gemma_config_from_hf, llama_params_from_hf),
+    "phi3": ("LlamaForCausalLM", phi3_config_from_hf, phi3_params_from_hf),
     "mixtral": ("MixtralForCausalLM", mixtral_config_from_hf, mixtral_params_from_hf),
     "gpt2": ("GPT2LMHeadModel", gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": ("BertForSequenceClassification", bert_config_from_hf, bert_params_from_hf),

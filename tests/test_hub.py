@@ -177,6 +177,41 @@ def test_mistral_logit_parity(rng):
     )
 
 
+def test_phi3_logit_parity(rng):
+    """model_type 'phi3' routes through the Llama family after splitting the
+    fused qkv_proj / gate_up_proj weights."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg)
+    ids = _ids(rng, 128, (2, 10))
+    ours = _convert(hf)
+    np.testing.assert_allclose(
+        np.asarray(ours(ids)), _logits(hf, ids), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_phi3_longrope_rejected(rng):
+    """Phi-3-128k-style rope_scaling must fail loudly, not convert wrong."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        original_max_position_embeddings=32, pad_token_id=0,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0] * 8,
+            "long_factor": [2.0] * 8,
+        },
+    )
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg)
+    with pytest.raises(ValueError, match="longrope"):
+        _convert(hf)
+
+
 def test_qwen2_logit_parity_attention_bias(rng):
     """Qwen2 = Llama architecture + q/k/v biases: conversion must carry them."""
     hf_cfg = transformers.Qwen2Config(
