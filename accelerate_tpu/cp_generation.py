@@ -23,7 +23,6 @@ parity between the two is pinned by tests/test_cp_generation.py.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -31,9 +30,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .models.llama import apply_rope, rms_norm, rotary_embedding
+from .models.llama import rms_norm, rotary_embedding
 from .ops.flash_attention import attention_stats
-from .generation import _mlp, _out_proj, _proj, sample_logits
+from .generation import (
+    _embed_tokens,
+    _mlp,
+    _norm_w,
+    _out_proj,
+    _qkv_proj,
+    sample_logits,
+)
 
 _CP_LOOP_CACHE: dict = {}
 
@@ -49,27 +55,6 @@ def _dp_axes(mesh) -> tuple:
     )
 
 
-def _tail_stats(q, k, v, valid_len):
-    """Online-softmax stats of q (B,1,Hq,D) against the tail cache
-    k/v (B,N,Hkv,D), masking slots >= valid_len. Returns (acc, m, l) like
-    :func:`attention_stats`."""
-    b, sq, hq, d = q.shape
-    hkv = k.shape[2]
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scale = 1.0 / np.sqrt(d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    slot = jnp.arange(k.shape[1], dtype=jnp.int32)
-    logits = jnp.where((slot < valid_len)[None, None, None, :], logits, -1e30)
-    m = jnp.max(logits, axis=-1)
-    p = jnp.exp(logits - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
-    return acc, m, l
-
-
 def _merge_stats(parts):
     """Exact combination of disjoint-keyset online-softmax partials."""
     m = parts[0][1]
@@ -81,11 +66,6 @@ def _merge_stats(parts):
     return out.transpose(0, 2, 1, 3)  # (B, Sq, H, D)
 
 
-def _norm_w(cfg, w, like):
-    plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
-    return (w + plus1).astype(like.dtype) if plus1 else w.astype(like.dtype)
-
-
 def _unpack(cfg, params):
     model_p = params["model"] if "model" in params else params
     stacked = model_p["layers"]["block"]
@@ -93,25 +73,6 @@ def _unpack(cfg, params):
     final_norm = model_p["norm"]["weight"]
     head = embed.T if cfg.tie_word_embeddings else params["lm_head"]["kernel"]
     return stacked, embed, final_norm, head
-
-
-def _qkv(cfg, attn, hn, cos, sin):
-    def proj(name):
-        y = _proj(hn, attn[name]["kernel"])
-        if "bias" in attn[name]:
-            y = y + attn[name]["bias"].astype(y.dtype)
-        return y
-
-    q = apply_rope(proj("q_proj"), cos, sin)
-    k = apply_rope(proj("k_proj"), cos, sin)
-    return q, k, proj("v_proj")
-
-
-def _embed_tokens(cfg, embed, ids):
-    x = jnp.take(embed, ids, axis=0).astype(cfg.dtype)
-    if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
-        x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
-    return x
 
 
 def _prefill(cfg, params, input_ids, mesh, batch_axes=()):
@@ -129,7 +90,7 @@ def _prefill(cfg, params, input_ids, mesh, batch_axes=()):
 
     def one_layer(h, p):
         hn = rms_norm(h, _norm_w(cfg, p["input_layernorm"]["weight"], h), eps)
-        q, k_new, v_new = _qkv(cfg, p["self_attn"], hn, cos, sin)
+        q, k_new, v_new = _qkv_proj(p["self_attn"], hn, cos, sin)
         out = ring_attention(q, k_new, v_new, causal=True, mesh=mesh, batch_axes=batch_axes)
         h = h + _out_proj(out.astype(h.dtype), p["self_attn"]["o_proj"]["kernel"])
         hn = rms_norm(h, _norm_w(cfg, p["post_attention_layernorm"]["weight"], h), eps)
@@ -166,14 +127,14 @@ def _decode_loop(cfg, params, first_token, prefix_k, prefix_v, max_new_tokens,
         def one_layer(h, layer):
             p, pk, pv, tk, tv = layer
             hn = rms_norm(h, _norm_w(cfg, p["input_layernorm"]["weight"], h), eps)
-            q, k_new, v_new = _qkv(cfg, p["self_attn"], hn, cos, sin)
+            q, k_new, v_new = _qkv_proj(p["self_attn"], hn, cos, sin)
             tk = jax.lax.dynamic_update_slice(tk, k_new.astype(tk.dtype), (0, t, 0, 0))
             tv = jax.lax.dynamic_update_slice(tv, v_new.astype(tv.dtype), (0, t, 0, 0))
             # Flash-decoding: partials against the LOCAL prefix shard (the
             # max/sum/value contractions over the sharded seq dim lower to
             # psums over cp), plus partials against the replicated tail.
             stats_prefix = attention_stats(q, pk, pv, causal=False)
-            stats_tail = _tail_stats(q, tk, tv, t + 1)
+            stats_tail = attention_stats(q, tk, tv, causal=False, kv_valid_len=t + 1)
             out = _merge_stats([stats_prefix, stats_tail])
             h = h + _out_proj(out.astype(h.dtype), p["self_attn"]["o_proj"]["kernel"])
             hn = rms_norm(h, _norm_w(cfg, p["post_attention_layernorm"]["weight"], h), eps)
@@ -206,7 +167,7 @@ def _decode_loop(cfg, params, first_token, prefix_k, prefix_v, max_new_tokens,
 
     finished = finished0 if finished0 is not None else jnp.zeros((b,), bool)
     key = rng if rng is not None else jax.random.key(0)
-    (_, _, _, _, _), toks = jax.lax.scan(
+    _, toks = jax.lax.scan(
         step,
         (first_token, tail_k, tail_v, finished, key),
         jnp.arange(max_new_tokens, dtype=jnp.int32),
@@ -241,7 +202,7 @@ def cp_generate(
         mesh = AcceleratorState().mesh
     cp = mesh.shape.get("cp", 1)
     b, s = input_ids.shape
-    if s % max(cp, 1) != 0:
+    if s % cp != 0:
         raise ValueError(f"prompt length {s} must divide by cp={cp}")
     if not cfg.scan_layers:
         raise ValueError("cp_generate requires scan_layers=True (stacked blocks)")

@@ -97,6 +97,33 @@ def _mlp(cfg, p, x):
     return (act(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
 
 
+def _norm_w(cfg, w, like):
+    """RMSNorm weight in compute dtype, honoring Gemma's (1+w) convention."""
+    plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
+    return (w + plus1).astype(like.dtype) if plus1 else w.astype(like.dtype)
+
+
+def _embed_tokens(cfg, embed, ids):
+    x = jnp.take(embed, ids, axis=0).astype(cfg.dtype)
+    if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
+        x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
+    return x
+
+
+def _qkv_proj(attn, hn, cos, sin):
+    """q/k (roped) + v projections for one Llama-family layer; carries
+    Qwen2-style attention biases when present."""
+    def proj(name):
+        y = _proj(hn, attn[name]["kernel"])
+        if "bias" in attn[name]:
+            y = y + attn[name]["bias"].astype(y.dtype)
+        return y
+
+    q = apply_rope(proj("q_proj"), cos, sin)
+    k = apply_rope(proj("k_proj"), cos, sin)
+    return q, k, proj("v_proj")
+
+
 def _attend(q, k, v, q_positions, kv_valid=None):
     """q (B,Sq,Hq,D) vs cached k/v (B,T,Hkv,D); causal wrt absolute cache
     slots. The causal bound kv_pos <= q_position also excludes unwritten
@@ -142,33 +169,21 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (b, s))
 
-    x = jnp.take(embed, input_ids, axis=0).astype(cfg.dtype)
-    if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
-        x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
+    x = _embed_tokens(cfg, embed, input_ids)
     rope_positions = positions
     if pad_offset is not None:
         rope_positions = jnp.maximum(positions - pad_offset[:, None], 0)
     cos, sin = rotary_embedding(rope_positions, cfg.head_dim, cfg.rope_theta, x.dtype)
-    plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
 
     def norm_w(w, like):
-        return (w + plus1).astype(like.dtype) if plus1 else w.astype(like.dtype)
+        return _norm_w(cfg, w, like)
 
     def one_layer(carry, layer):
         h = carry
         p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
         attn = p["self_attn"]
         hn = rms_norm(h, norm_w(p["input_layernorm"]["weight"], h), cfg.rms_norm_eps)
-
-        def qkv(name):
-            y = _proj(hn, attn[name]["kernel"])
-            if "bias" in attn[name]:  # Qwen2-style attention_bias checkpoints
-                y = y + attn[name]["bias"].astype(y.dtype)
-            return y
-
-        q = apply_rope(qkv("q_proj"), cos, sin)
-        k_new = apply_rope(qkv("k_proj"), cos, sin)
-        v_new = qkv("v_proj")
+        q, k_new, v_new = _qkv_proj(attn, hn, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions, kv_valid)
@@ -439,9 +454,7 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
         p, ck, cv = layer
         attn = p["self_attn"]
         hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
-        q = apply_rope(_proj(hn, attn["q_proj"]["kernel"]), cos, sin)
-        k_new = apply_rope(_proj(hn, attn["k_proj"]["kernel"]), cos, sin)
-        v_new = _proj(hn, attn["v_proj"]["kernel"])
+        q, k_new, v_new = _qkv_proj(attn, hn, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions, kv_valid)

@@ -99,10 +99,12 @@ def blockwise_attention(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, Sq, H, D)
 
 
-def attention_stats(q, k, v, *, causal=True, q_offset=0, k_offset=0):
+def attention_stats(q, k, v, *, causal=True, q_offset=0, k_offset=0,
+                    kv_valid_len=None):
     """One-chunk attention returning ONLINE-SOFTMAX STATS instead of the
     normalized output: (acc[B,H,Sq,D] fp32, m[B,H,Sq], l[B,H,Sq]). Ring
-    attention merges these across KV rotations."""
+    attention merges these across KV rotations; cp_generation's decode uses
+    ``kv_valid_len`` (traced ok) to mask unwritten tail-cache slots."""
     b, sq, hq, d = q.shape
     k, v = _repeat_kv(k, v, hq)
     scale = 1.0 / np.sqrt(d)
@@ -112,6 +114,9 @@ def attention_stats(q, k, v, *, causal=True, q_offset=0, k_offset=0):
     if causal:
         cmask = q_pos[:, None] >= k_pos[None, :]
         logits = jnp.where(cmask[None, None], logits, NEG_INF)
+    if kv_valid_len is not None:
+        slot = jnp.arange(k.shape[1], dtype=jnp.int32)
+        logits = jnp.where((slot < kv_valid_len)[None, None, None, :], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)
     p = jnp.exp(logits - m[..., None])
     l = jnp.sum(p, axis=-1)
