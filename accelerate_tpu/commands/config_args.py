@@ -58,6 +58,7 @@ class LaunchConfig:
     sp_size: int = 1
     ep_size: int = 1
     pp_size: int = 1
+    pp_virtual_stages: int = 1  # interleaved pipeline schedule (bubble/V)
     # FSDP/ZeRO policy.
     use_fsdp: bool = False
     fsdp_sharding_strategy: str = "FULL_SHARD"
@@ -140,6 +141,7 @@ class LaunchConfig:
             "PARALLELISM_CONFIG_SP_SIZE": self.sp_size,
             "PARALLELISM_CONFIG_EP_SIZE": self.ep_size,
             "PARALLELISM_CONFIG_PP_SIZE": self.pp_size,
+            "PARALLELISM_CONFIG_PP_VIRTUAL_STAGES": self.pp_virtual_stages,
         }
         if any(v > 1 for v in parallel.values()):
             env.update({k: str(v) for k, v in parallel.items()})

@@ -183,6 +183,12 @@ def convert_reference_config(ref: dict) -> tuple[LaunchConfig, list[str]]:
             f"MEGATRON_LM → tp={cfg.tp_size} x pp={cfg.pp_size} x dp={cfg.dp_replicate_size} "
             "(native mesh axes; Megatron engine knobs dropped)"
         )
+        if m.get("megatron_lm_num_layers_per_virtual_pipeline_stage"):
+            notes.append(
+                "num_layers_per_virtual_pipeline_stage → set "
+                "ParallelismConfig(pp_virtual_stages=L/(pp*layers_per_chunk)) "
+                "— the interleaved schedule is pipeline_apply(virtual_stages=V)"
+            )
     else:
         notes.append(f"unsupported distributed_type {dist!r}: kept single-process defaults")
 

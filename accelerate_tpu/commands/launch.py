@@ -45,6 +45,8 @@ def add_launch_args(p: argparse.ArgumentParser):
     par = p.add_argument_group("parallelism degrees")
     for ax in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         par.add_argument(f"--{ax}_size", type=int, default=None)
+    par.add_argument("--pp_virtual_stages", type=int, default=None,
+                     help="Interleaved pipeline schedule degree (bubble/V)")
 
     f = p.add_argument_group("FSDP / ZeRO")
     f.add_argument("--use_fsdp", action="store_true", default=None)
@@ -101,6 +103,7 @@ def resolve_launch_config(args: argparse.Namespace) -> LaunchConfig:
     }
     for ax in ("dp_replicate", "dp_shard", "tp", "cp", "sp", "ep", "pp"):
         overrides[f"{ax}_size"] = getattr(args, f"{ax}_size")
+    overrides["pp_virtual_stages"] = args.pp_virtual_stages
     for k, v in overrides.items():
         if v is not None:
             setattr(cfg, k, v)

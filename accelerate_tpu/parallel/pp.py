@@ -37,6 +37,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 _EAGER_CACHE: dict = {}
 
 
+def _resolve_virtual_stages(virtual_stages: Optional[int]) -> int:
+    """Explicit arg > ParallelismConfig.pp_virtual_stages > 1."""
+    if virtual_stages is not None:
+        return int(virtual_stages)
+    from ..state import AcceleratorState, is_initialized
+
+    if is_initialized():
+        pc = getattr(AcceleratorState(), "parallelism_config", None)
+        if pc is not None:
+            return int(getattr(pc, "pp_virtual_stages", 1) or 1)
+    return 1
+
+
 def _active_mesh(mesh: Optional[Mesh]) -> Mesh:
     if mesh is not None:
         return mesh
@@ -57,7 +70,7 @@ def pipeline_apply(
     mesh: Optional[Mesh] = None,
     n_microbatches: Optional[int] = None,
     axis_name: str = "pp",
-    virtual_stages: int = 1,
+    virtual_stages: Optional[int] = None,
 ) -> jax.Array:
     """Run ``x`` through a layer stack pipelined over the ``pp`` mesh axis.
 
@@ -77,7 +90,8 @@ def pipeline_apply(
         fill/drain bubble shrinks to ``(pp-1)/(V*m)`` of the work — the
         interleaved schedule's whole point. V>1 requires
         ``n_microbatches == pp`` per call (run several calls for larger
-        batches; gradient accumulation sums them anyway).
+        batches; gradient accumulation sums them anyway). Defaults to
+        ``ParallelismConfig.pp_virtual_stages`` when an Accelerator is live.
 
     Returns ``(B, ...)`` outputs, replicated over ``pp`` like the input.
     """
@@ -85,11 +99,12 @@ def pipeline_apply(
     n_stages = mesh.shape.get(axis_name, 1)
     if n_stages == 1:
         return stage_fn(stage_params, x)
-    if int(virtual_stages) > 1:
+    v_stages = _resolve_virtual_stages(virtual_stages)
+    if v_stages > 1:
         return _pipeline_apply_interleaved(
             stage_fn, stage_params, x, mesh=mesh,
             n_microbatches=n_microbatches, axis_name=axis_name,
-            v_stages=int(virtual_stages),
+            v_stages=v_stages,
         )
 
     n_micro = int(n_microbatches or n_stages)
@@ -361,7 +376,7 @@ def llama_pipeline_forward(
     *,
     mesh: Optional[Mesh] = None,
     n_microbatches: Optional[int] = None,
-    virtual_stages: int = 1,
+    virtual_stages: Optional[int] = None,
 ) -> jax.Array:
     """Pipelined equivalent of ``LlamaForCausalLM.apply`` (logits).
 

@@ -62,6 +62,13 @@ class ParallelismConfig:
     # utils/dataclasses.py:2205-2231).
     cp_rotate_method: str = "alltoall"
 
+    # Interleaving degree for the pipeline schedule (Megatron's
+    # num_layers_per_virtual_pipeline_stage knob, expressed as the virtual
+    # multiplier: each device holds this many non-contiguous layer chunks and
+    # the fill/drain bubble shrinks by the same factor). Consumed by
+    # parallel/pp.py's pipeline_apply / llama_pipeline_forward defaults.
+    pp_virtual_stages: int = 1
+
     def __post_init__(self):
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
@@ -79,6 +86,10 @@ class ParallelismConfig:
             raise ValueError(
                 "ep_size must divide into dp_shard*sp*tp (experts are sharded over "
                 f"those axes); got ep={self.ep_size}"
+            )
+        if not isinstance(self.pp_virtual_stages, int) or self.pp_virtual_stages < 1:
+            raise ValueError(
+                f"pp_virtual_stages must be a positive int, got {self.pp_virtual_stages!r}"
             )
 
     # ------------------------------------------------------------------
@@ -192,6 +203,7 @@ class ParallelismConfig:
             ep_size=get_int_from_env([f"{p}EP_SIZE"], 1),
             pp_size=get_int_from_env([f"{p}PP_SIZE"], 1),
             cp_rotate_method=parse_choice_from_env(f"{p}CP_ROTATE_METHOD", "alltoall"),
+            pp_virtual_stages=get_int_from_env([f"{p}PP_VIRTUAL_STAGES"], 1),
         )
 
     def to_env(self) -> dict[str, str]:
@@ -205,6 +217,7 @@ class ParallelismConfig:
             f"{p}EP_SIZE": str(self.ep_size),
             f"{p}PP_SIZE": str(self.pp_size),
             f"{p}CP_ROTATE_METHOD": self.cp_rotate_method,
+            f"{p}PP_VIRTUAL_STAGES": str(self.pp_virtual_stages),
         }
         return env
 
