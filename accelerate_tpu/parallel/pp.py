@@ -38,16 +38,22 @@ _EAGER_CACHE: dict = {}
 
 
 def _resolve_virtual_stages(virtual_stages: Optional[int]) -> int:
-    """Explicit arg > ParallelismConfig.pp_virtual_stages > 1."""
+    """Explicit arg > live ParallelismConfig.pp_virtual_stages > env > 1.
+
+    The AcceleratorState peek is PASSIVE (reads the borg dict): constructing
+    the singleton here would initialize the whole runtime as a side effect of
+    a mesh-only pipeline_apply call — and poison a later
+    Accelerator(parallelism_config=...) with 'already initialized'."""
     if virtual_stages is not None:
         return int(virtual_stages)
-    from ..state import AcceleratorState, is_initialized
+    import os
 
-    if is_initialized():
-        pc = getattr(AcceleratorState(), "parallelism_config", None)
-        if pc is not None:
-            return int(getattr(pc, "pp_virtual_stages", 1) or 1)
-    return 1
+    from ..state import AcceleratorState
+
+    pc = AcceleratorState._shared_state.get("parallelism_config")
+    if pc is not None:
+        return int(getattr(pc, "pp_virtual_stages", 1) or 1)
+    return int(os.environ.get("PARALLELISM_CONFIG_PP_VIRTUAL_STAGES", 1) or 1)
 
 
 def _active_mesh(mesh: Optional[Mesh]) -> Mesh:

@@ -284,6 +284,8 @@ class ParallelismConfig:
             active["ep"] = self.ep_size
         if self.pp_size > 1:
             active["pp"] = self.pp_size
+        if self.pp_virtual_stages > 1:
+            active["pp_virtual_stages"] = self.pp_virtual_stages
         return f"ParallelismConfig({active or 'single-device'})"
 
 
