@@ -46,14 +46,19 @@ def _resolve_virtual_stages(virtual_stages: Optional[int]) -> int:
     Accelerator(parallelism_config=...) with 'already initialized'."""
     if virtual_stages is not None:
         return int(virtual_stages)
-    import os
-
     from ..state import AcceleratorState
+    from ..utils.constants import PARALLELISM_CONFIG_PREFIX
+    from ..utils.environment import get_int_from_env
 
     pc = AcceleratorState._shared_state.get("parallelism_config")
     if pc is not None:
         return int(getattr(pc, "pp_virtual_stages", 1) or 1)
-    return int(os.environ.get("PARALLELISM_CONFIG_PP_VIRTUAL_STAGES", 1) or 1)
+    v = get_int_from_env([f"{PARALLELISM_CONFIG_PREFIX}PP_VIRTUAL_STAGES"], 1)
+    if v < 1:
+        raise ValueError(
+            f"PARALLELISM_CONFIG_PP_VIRTUAL_STAGES must be a positive int, got {v}"
+        )
+    return v
 
 
 def _active_mesh(mesh: Optional[Mesh]) -> Mesh:

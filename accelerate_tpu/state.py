@@ -407,7 +407,19 @@ class AcceleratorState:
         """The global device mesh, built lazily from parallelism_config (or a
         pure-DP mesh over all devices when no config was given)."""
         if self._mesh is None:
-            cfg = self.parallelism_config or ParallelismConfig()
+            cfg = self.parallelism_config
+            if cfg is None:
+                # Lazily inferred config must still honor env knobs that are
+                # meaningful without mesh degrees (pp_virtual_stages) — else
+                # the first mesh access silently overwrites the env default
+                # that pipeline_apply's resolution would otherwise see.
+                from .utils.environment import get_int_from_env
+
+                cfg = ParallelismConfig(
+                    pp_virtual_stages=get_int_from_env(
+                        ["PARALLELISM_CONFIG_PP_VIRTUAL_STAGES"], 1
+                    )
+                )
             self._mesh = cfg.infer_missing_axis(len(self._partial.devices)).build_mesh(
                 self._partial.devices
             )

@@ -85,6 +85,7 @@ _BY_FEATURE_OK = {
     "cross_validation.py": "cross-validation OK",
     "fsdp_with_peak_mem_tracking.py": "fsdp peak-mem OK",
     "long_context_generation.py": "long-context generation OK",
+    "distillation.py": "distillation OK",
 }
 
 
@@ -151,6 +152,7 @@ _FEATURE_MARKERS = {
     "cross_validation.py": ["fold_split"],
     "fsdp_with_peak_mem_tracking.py": ["FullyShardedDataParallelPlugin", "memory_stats"],
     "long_context_generation.py": ["cp_generate"],
+    "distillation.py": ["model=student", "_state_slot"],
 }
 
 
