@@ -4,8 +4,8 @@
 ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 PYTEST = $(ENV) python -m pytest -q
 
-.PHONY: test test_core test_models test_parallel test_big_modeling test_cli \
-        test_examples test_checkpointing test_hub quality bench
+.PHONY: test test_smoke test_core test_models test_parallel test_big_modeling \
+        test_cli test_examples test_checkpointing test_hub quality bench
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -18,6 +18,15 @@ test:
 
 test_serial:
 	$(PYTEST) tests/
+
+# Smoke tier (<10 min serial on one core): one representative file per
+# subsystem — runtime/mesh, collectives, data, training loop, flagship model,
+# generation, checkpoint roundtrip, review regressions. The full suite is the
+# bar; this is the budget-constrained pre-commit gate.
+test_smoke:
+	$(PYTEST) tests/test_state_and_mesh.py tests/test_operations.py \
+	    tests/test_training.py tests/test_llama.py tests/test_megatron.py \
+	    tests/test_review_regressions.py
 
 # Runtime + ops + data + training loop (excludes models/examples/big-model).
 test_core:

@@ -452,11 +452,20 @@ class Accelerator:
     # ------------------------------------------------------------------
 
     def prepare(self, *args, device_placement=None):
-        """Prepare model/optimizer/dataloader/scheduler objects in any order,
-        returning them in the same order (reference: accelerator.py:1414)."""
+        """Prepare model/optimizer/dataloader/scheduler objects, returning
+        them in the same order (reference: accelerator.py:1414).
+
+        Optimizer pairing contract: each optax optimizer binds to the nearest
+        model *before* it in the argument list that doesn't already have one —
+        ``prepare(model, tx)``, ``prepare(gen, gen_tx, disc, disc_tx)``,
+        ``prepare(student, tx, frozen_teacher)`` and
+        ``prepare(frozen_teacher, student, tx)`` all do what they look like.
+        An optimizer before any model, or two optimizers after the same model,
+        raises. (The torch reference pairs via param references; a functional
+        tx has none, so argument adjacency is the contract.)
+        """
         result = []
         models = [a for a in args if isinstance(a, Model)]
-        txs = [a for a in args if _is_optax_tx(a)]
         for model in models:
             if self.verify_device_map(model):
                 # Same guard as the reference (accelerator.py:3744-3760): a
@@ -470,12 +479,41 @@ class Accelerator:
                     "before calling prepare()."
                 )
 
-        # Models pair with optimizers in order of appearance (the torch
-        # reference gets this pairing implicitly from param references; a
-        # functional optimizer has none, so order is the contract). A lone
-        # trailing model without an optimizer is prepared inference-only.
+        # Models pair with optimizers by ADJACENCY in args order: each
+        # optimizer binds to the nearest *preceding* model that does not have
+        # one yet (the torch reference gets pairing implicitly from param
+        # references; a functional optax tx has none, so argument order is
+        # the contract). prepare(frozen_teacher, student, tx) therefore binds
+        # tx to `student`; two optimizers after the same model is ambiguous
+        # and raises. A model without a following optimizer is prepared
+        # inference-only (e.g. a frozen teacher).
+        pairings: list = [None] * len(models)  # models index -> tx
+        tx_models: list = []  # per tx, in args order: the Model it binds to
+        cur = -1  # index into `models` of the most recent model seen
+        for obj in args:
+            if isinstance(obj, Model):
+                cur += 1
+            elif _is_optax_tx(obj):
+                if not models:
+                    tx_models.append(None)  # lone optimizer: wrap unbound
+                    continue
+                if cur < 0:
+                    raise ValueError(
+                        "prepare() received an optimizer before any model; pass "
+                        "each optimizer after the model it should train, e.g. "
+                        "prepare(model, tx, dataloader)."
+                    )
+                if pairings[cur] is not None:
+                    raise ValueError(
+                        "prepare() optimizer pairing is ambiguous: two optimizers "
+                        "follow the same model. Pass each optimizer immediately "
+                        "after its own model, e.g. prepare(gen, gen_tx, disc, "
+                        "disc_tx)."
+                    )
+                pairings[cur] = obj
+                tx_models.append(models[cur])
         for i, model in enumerate(models):
-            self._prepare_state(model, txs[i] if i < len(txs) else None)
+            self._prepare_state(model, pairings[i])
         tx_seen = 0
 
         for obj in args:
@@ -485,12 +523,12 @@ class Accelerator:
                 # Pairing already bound this tx to its model's slot above;
                 # prepare_optimizer must only wrap, not re-bind it to some
                 # other (optimizer-less) slot — e.g. a frozen teacher.
-                slot_for_tx = (
-                    models[tx_seen]._state_slot if tx_seen < len(models) else None
-                )
+                bound = tx_models[tx_seen]
                 result.append(
                     self.prepare_optimizer(
-                        obj, _already_bound=bool(models), _bound_slot=slot_for_tx
+                        obj,
+                        _already_bound=bound is not None,
+                        _bound_slot=bound._state_slot if bound is not None else None,
                     )
                 )
                 tx_seen += 1

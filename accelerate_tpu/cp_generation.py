@@ -202,6 +202,10 @@ def cp_generate(
         mesh = AcceleratorState().mesh
     cp = mesh.shape.get("cp", 1)
     b, s = input_ids.shape
+    if max_new_tokens <= 0:
+        # (B, S + 0): the documented contract — matches generation.generate,
+        # whose lax.scan over arange(0) appends nothing.
+        return jnp.asarray(input_ids, jnp.int32)
     if s % cp != 0:
         raise ValueError(f"prompt length {s} must divide by cp={cp}")
     if not cfg.scan_layers:

@@ -89,5 +89,7 @@ from .megatron import (
     megatron_config_from_args,
     llama_params_to_megatron_core,
     megatron_core_params_to_llama,
+    megatron_legacy_to_core,
+    megatron_params_to_llama,
     merge_megatron_tp_shards,
 )

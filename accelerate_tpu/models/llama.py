@@ -310,7 +310,8 @@ class LlamaForCausalLM(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         x = LlamaModel(cfg, name="model")(input_ids)
-        if cfg.tie_word_embeddings:
+        x = _pin_last_dim_replicated(x)  # see helper: kills FSDP param-sharding
+        if cfg.tie_word_embeddings:     # propagation into the loss graph
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             return x @ embed.T.astype(cfg.dtype)
         return nn.Dense(
@@ -361,6 +362,11 @@ def fused_cross_entropy_loss(config, params, input_ids, labels,
     """
     cfg = config
     hidden = LlamaModel(cfg, name="model").apply({"params": params["model"]}, input_ids)
+    # Same FSDP/HSDP propagation fix as LlamaForCausalLM.__call__ /
+    # cross_entropy_loss: without these pins the sharded head param leaks
+    # vocab/hidden sharding into the scan-local loss graph and the backward
+    # pays an involuntary full rematerialization (see _pin_last_dim_replicated).
+    hidden = _pin_last_dim_replicated(hidden)
     if cfg.tie_word_embeddings:
         head = params["model"]["embed_tokens"]["embedding"].T
     else:
@@ -377,7 +383,7 @@ def fused_cross_entropy_loss(config, params, input_ids, labels,
     @jax.checkpoint
     def chunk_loss(carry, xs):
         hx, y = xs
-        logits = (hx @ head).astype(jnp.float32)  # (B, C, V) — scan-local
+        logits = _pin_last_dim_replicated((hx @ head).astype(jnp.float32))
         valid = y != ignore_index
         safe = jnp.where(valid, y, 0)
         lse = jax.nn.logsumexp(logits, axis=-1)
@@ -390,11 +396,52 @@ def fused_cross_entropy_loss(config, params, input_ids, labels,
     return loss_sum / jnp.maximum(count, 1)
 
 
+def _pin_last_dim_replicated(x):
+    """Constrain ``x``'s last dim to replicated; other dims stay
+    UNCONSTRAINED (free for batch/seq propagation).
+
+    Applied at the two activation boundaries around the unembed matmul
+    (final hidden and logits). Under FSDP/HSDP every param — including 1-D
+    norm scales and the lm_head kernel — is sharded over ``dp_shard``, and
+    shardy propagates those param shardings into the activations (hidden /
+    vocab dim sharded), while the label-scatter path of the CE backward
+    stays batch-sharded. The mismatched cotangents meet in an ``add_any``
+    that GSPMD can only reconcile by involuntary full rematerialization
+    (replicate + repartition — the ``[SPMD]`` compile warning; wasted HBM +
+    ICI every step). Pinning just the feature dim keeps the loss graph
+    batch-sharded; sharded params are all-gathered at use like any other
+    FSDP weight. (Block outputs are feature-replicated under Megatron-style
+    TP too, so this is sharding-neutral for TP/CP/SP.) Passive singleton
+    peek (no AcceleratorState construction) for the same reason as
+    parallel/pp.py:_resolve_virtual_stages."""
+    from ..state import AcceleratorState
+
+    mesh = AcceleratorState._shared_state.get("_mesh")
+    if mesh is None or getattr(x, "ndim", 0) < 2:
+        return x
+    if mesh.shape.get("pp", 1) > 1:
+        # Under GPipe the last stage computes the unembed inside shard_map
+        # with its own stage-local layout; pinning the collected logits on
+        # the global mesh would force a conflicting reshard in the backward
+        # ppermute chain (observed as a fresh [SPMD] remat warning).
+        return x
+    if mesh.shape.get("tp", 1) > 1:
+        # Megatron-style vocab-parallel TP (llama_tp_rules shards
+        # lm_head/kernel and the embedding on tp) deliberately keeps the
+        # vocab dim of logits tp-sharded; forcing replication here would
+        # all-gather the full fp32 (B,S,V) logits every step.
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(*([P.UNCONSTRAINED] * (x.ndim - 1)), None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     """Token-level CE with masking — computed in fp32 regardless of compute
     dtype (loss reductions always fp32 on TPU to avoid bf16 accumulation
     error)."""
-    logits = logits.astype(jnp.float32)
+    logits = _pin_last_dim_replicated(logits).astype(jnp.float32)
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -7,22 +7,31 @@ degrees, fused kernels, distributed optimizer — are native mesh features, so
 what remains of the integration surface is checkpoint portability: take a
 Megatron-saved GPT/Llama model and run (or fine-tune) it on the mesh.
 
-Scope: the **megatron-core** GPT layout (``model.decoder.layers.N...``):
-``linear_qkv`` fused per GQA group ``[ng * (q_per_group + 2) * hn, h]``
-(queries of the group, then its K, then its V), ``linear_fc1`` as
-gate-then-up halves for SwiGLU, RMSNorm weights, rotary positions — maps
-onto :class:`LlamaConfig`. The legacy
-``language_model.encoder.*`` layout is NOT converted (its names appear in
-the TP-merge rules only so merged legacy dicts are at least
-partition-correct for custom converters).
+Scope: two layouts (reference: utils/megatron_lm.py:876-926 consumes both
+via megatron.core's own loader):
+
+- the **megatron-core** GPT layout (``model.decoder.layers.N...``):
+  ``linear_qkv`` fused per GQA group ``[ng * (q_per_group + 2) * hn, h]``
+  (queries of the group, then its K, then its V), ``linear_fc1`` as
+  gate-then-up halves for SwiGLU, RMSNorm weights, rotary positions — maps
+  onto :class:`LlamaConfig`.
+- the **legacy** ``language_model.encoder.*`` layout (checkpoint_version
+  >= 2.0, whose fused QKV ordering is per-head/group q...q k v — identical
+  to core's): names translate to core via
+  :func:`megatron_legacy_to_core`, then the core converter runs. Learned
+  absolute position embeddings (GPT-2-style legacy) have no rotary-Llama
+  counterpart and raise; checkpoint_version < 2.0 (interleaved QKV) raises.
 
 TP-sharded checkpoints (``mp_rank_00 ... mp_rank_0{T-1}``) merge before
 conversion: column-parallel weights concat on the output dim, row-parallel on
 the input dim, per Megatron's partitioning rules — EXCEPT SwiGLU's fc1,
 where each rank holds its own ``[gate_r; up_r]`` halves (the glu chunks the
 *local* output), so gate and up merge separately. Pipeline-parallel
-checkpoints (``mp_rank_XX_YYY`` dirs, per-stage layer numbering) are
-rejected with a clear error.
+checkpoints (``mp_rank_XX_YYY`` dirs, one dir per (tp, pp) rank with
+per-stage local layer numbering) load stage-by-stage: layer indices are
+renumbered by each stage's offset and the stages union into one flat dict
+per TP rank (embedding from the first stage, final norm / output layer from
+the last, the tied ``word_embeddings_for_head`` copy dropped).
 
 Verified by inverse-roundtrip tests (tests/test_megatron.py) — synthetic
 checkpoints in these layouts convert to logit-parity with the native modules;
@@ -42,6 +51,8 @@ __all__ = [
     "merge_megatron_tp_shards",
     "megatron_config_from_args",
     "megatron_core_params_to_llama",
+    "megatron_legacy_to_core",
+    "megatron_params_to_llama",
     "llama_params_to_megatron_core",
 ]
 
@@ -77,47 +88,105 @@ def _flatten_torch_tree(obj, prefix="") -> dict[str, np.ndarray]:
     return out
 
 
+def _rank_file(it_dir: str, rank_dir: str) -> str:
+    for name in ("model_optim_rng.pt", "model_rng.pt"):
+        p = os.path.join(it_dir, rank_dir, name)
+        if os.path.isfile(p):
+            return p
+    raise FileNotFoundError(f"no checkpoint file under {it_dir}/{rank_dir}")
+
+
+_LAYER_KEY = re.compile(r"((?:decoder|language_model\.encoder)\.layers\.)(\d+)(\..+)")
+
+
+def _merge_pp_stages(stages: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    """Union PP-stage dicts into one, renumbering each stage's local layer
+    indices by the running offset (stage s's ``layers.0`` becomes global
+    ``layers.sum(len(earlier stages))``). Non-layer keys keep their first
+    occurrence (embedding lives on the first stage, final norm / output layer
+    on the last); the tied-embedding copy Megatron stores on the last stage
+    (``word_embeddings_for_head``) is dropped."""
+    merged: dict[str, np.ndarray] = {}
+    offset = 0
+    for sd in stages:
+        local_count = 0
+        for k, v in sd.items():
+            m = _LAYER_KEY.match(k)
+            if m:
+                idx = int(m.group(2))
+                local_count = max(local_count, idx + 1)
+                merged[f"{m.group(1)}{idx + offset}{m.group(3)}"] = v
+            elif "word_embeddings_for_head" in k:
+                continue
+            elif k not in merged:
+                merged[k] = v
+        offset += local_count
+    return merged
+
+
 def load_megatron_checkpoint(path: str) -> tuple[list[dict[str, np.ndarray]], Any]:
     """Load a Megatron checkpoint directory into per-TP-rank flat dicts.
 
     ``path`` may be the experiment root (``latest_checkpointed_iteration.txt``
     resolves the iteration), an ``iter_*`` dir holding ``mp_rank_*``
-    subdirs, or a single ``.pt`` file. Returns ``(shards, args)``: one flat
+    subdirs, or a single ``.pt`` file. Both TP-only (``mp_rank_0T``) and
+    TP×PP (``mp_rank_0T_00P``, per-stage layer numbering) directory layouts
+    load; PP stages are renumbered and unioned per TP rank
+    (:func:`_merge_pp_stages`). Returns ``(shards, args)``: one flat
     ``{dotted_name: np.ndarray}`` per TP rank in rank order (pass to
     :func:`merge_megatron_tp_shards`) plus the checkpoint's stored Megatron
     ``args`` (for :func:`megatron_config_from_args`; None if absent).
     """
     import torch
 
+    args = None
+    version = None
+
+    def _load(f):
+        nonlocal args, version
+        payload = torch.load(f, map_location="cpu", weights_only=False)
+        model = payload.get("model", payload) if isinstance(payload, dict) else payload
+        if isinstance(payload, dict):
+            if args is None:
+                args = payload.get("args")
+            if version is None:
+                version = payload.get("checkpoint_version")
+        return _flatten_torch_tree(model)
+
     if os.path.isfile(path):
-        files = [path]
+        shards = [_load(path)]
     else:
         it_dir = _latest_iteration(path)
         ranks = sorted(d for d in os.listdir(it_dir) if d.startswith("mp_rank_"))
         if not ranks:
             raise FileNotFoundError(f"no mp_rank_* dirs under {it_dir}")
-        if any(re.fullmatch(r"mp_rank_\d+_\d+", r) for r in ranks):
-            raise NotImplementedError(
-                "pipeline-parallel Megatron checkpoints (mp_rank_XX_YYY dirs, "
-                "per-stage layer numbering) are not supported — merge PP "
-                "stages with Megatron's own tools first"
-            )
-        files = []
-        for r in ranks:
-            for name in ("model_optim_rng.pt", "model_rng.pt"):
-                p = os.path.join(it_dir, r, name)
-                if os.path.isfile(p):
-                    files.append(p)
-                    break
-            else:
-                raise FileNotFoundError(f"no checkpoint file under {it_dir}/{r}")
-    shards, args = [], None
-    for f in files:
-        payload = torch.load(f, map_location="cpu", weights_only=False)
-        model = payload.get("model", payload) if isinstance(payload, dict) else payload
-        if isinstance(payload, dict) and args is None:
-            args = payload.get("args")
-        shards.append(_flatten_torch_tree(model))
+        pp_ranks = [re.fullmatch(r"mp_rank_(\d+)_(\d+)", r) for r in ranks]
+        if any(pp_ranks):
+            if not all(pp_ranks):
+                raise ValueError(f"mixed TP-only and TP×PP rank dirs under {it_dir}")
+            by_tp: dict[int, list[tuple[int, str]]] = {}
+            for m in pp_ranks:
+                by_tp.setdefault(int(m.group(1)), []).append((int(m.group(2)), m.group(0)))
+            shards = []
+            for tp in sorted(by_tp):
+                stages = [_load(_rank_file(it_dir, r)) for _, r in sorted(by_tp[tp])]
+                shards.append(_merge_pp_stages(stages))
+        else:
+            shards = [_load(_rank_file(it_dir, r)) for r in ranks]
+    # Megatron semantics: a missing checkpoint_version key means 0 (the oldest
+    # format). Only the legacy language_model.* layout ever existed pre-2.0 —
+    # core-layout dicts are always modern, so absence is fine there.
+    if version is None and any(
+        k.startswith("language_model.") for sd in shards for k in sd
+    ):
+        version = 0
+    if version is not None and float(version) < 2.0:
+        raise NotImplementedError(
+            f"Megatron checkpoint_version {version} < 2.0 stores fused QKV in "
+            "the old interleaved ordering (and omitting the key means 0); "
+            "re-save with a current Megatron (or fix_query_key_value_ordering) "
+            "first"
+        )
     return shards, args
 
 
@@ -161,6 +230,72 @@ def merge_megatron_tp_shards(
         else:
             merged[name] = parts[0]  # replicated (norms, row-parallel biases)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# legacy (language_model.encoder.*) -> megatron-core names
+# ---------------------------------------------------------------------------
+
+# Per-layer legacy -> core renames. ``.attention.`` is the pre-2.x spelling of
+# ``.self_attention.``. post_attention_layernorm maps to pre_mlp_layernorm
+# (same tensor, core renamed it).
+_LEGACY_LAYER_RENAMES = [
+    (re.compile(r"\.(?:self_)?attention\.query_key_value\."), ".self_attention.linear_qkv."),
+    (re.compile(r"\.(?:self_)?attention\.dense\."), ".self_attention.linear_proj."),
+    (re.compile(r"\.mlp\.dense_h_to_4h\."), ".mlp.linear_fc1."),
+    (re.compile(r"\.mlp\.dense_4h_to_h\."), ".mlp.linear_fc2."),
+    (re.compile(r"\.post_attention_layernorm\."), ".pre_mlp_layernorm."),
+]
+
+
+def is_legacy_megatron(sd: dict[str, np.ndarray]) -> bool:
+    return any(k.startswith("language_model.") for k in sd)
+
+
+def megatron_legacy_to_core(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Rename a legacy ``language_model.encoder.*`` flat dict to megatron-core
+    names so :func:`megatron_core_params_to_llama` can convert it.
+
+    The fused-QKV row ordering is unchanged — for checkpoint_version >= 2.0
+    legacy stores per-head/group ``q...q k v`` rows exactly like core
+    (``load_megatron_checkpoint`` rejects older versions). Derived buffers
+    (``rotary_pos_emb.inv_freq``, ``_extra_state``) and the last-PP-stage tied
+    embedding copy are dropped. GPT-2-style learned position embeddings have
+    no rotary counterpart and raise.
+    """
+    if any("position_embeddings" in k for k in sd):
+        raise ValueError(
+            "legacy checkpoint has learned absolute position embeddings "
+            "(GPT-2-style); the rotary Llama family cannot represent them"
+        )
+    out: dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        if "_extra_state" in k or "rotary_pos_emb" in k or "word_embeddings_for_head" in k:
+            continue
+        name = k
+        if name.startswith("language_model."):
+            name = name[len("language_model."):]
+        if name.startswith("encoder.layers."):
+            name = "decoder." + name[len("encoder."):]
+            for pat, repl in _LEGACY_LAYER_RENAMES:
+                name = pat.sub(repl, name)
+        elif name.startswith("encoder.final_layernorm.") or name.startswith("encoder.final_norm."):
+            name = "decoder.final_layernorm." + name.rsplit(".", 1)[1]
+        elif name.startswith("embedding.word_embeddings."):
+            pass  # same spelling in core
+        elif name.startswith("output_layer."):
+            pass
+        out[name] = v
+    return out
+
+
+def megatron_params_to_llama(cfg, sd: dict[str, np.ndarray]) -> dict:
+    """Layout-dispatching converter: translates legacy dicts to core names
+    first (:func:`megatron_legacy_to_core`), then runs
+    :func:`megatron_core_params_to_llama`."""
+    if is_legacy_megatron(sd):
+        sd = megatron_legacy_to_core(sd)
+    return megatron_core_params_to_llama(cfg, sd)
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,12 @@ class AcceleratedOptimizer:
         lr = None
         if self._accelerator is not None and self._accelerator._scheduler is not None:
             lr = self._accelerator._scheduler.get_last_lr()
+        if lr is None:
+            # Schedule embedded in the optax chain (inject_hyperparams):
+            # read the live lr straight from opt_state.
+            from .scheduler import extract_lr_info
+
+            lr = extract_lr_info(self.state).get("lr")
         return [{"params": [], "lr": lr}]
 
     def zero_grad(self, set_to_none: bool = True):

@@ -45,7 +45,10 @@ def _resolve_virtual_stages(virtual_stages: Optional[int]) -> int:
     a mesh-only pipeline_apply call — and poison a later
     Accelerator(parallelism_config=...) with 'already initialized'."""
     if virtual_stages is not None:
-        return int(virtual_stages)
+        v = int(virtual_stages)
+        if v < 1:
+            raise ValueError(f"virtual_stages must be a positive int, got {virtual_stages}")
+        return v
     from ..state import AcceleratorState
     from ..utils.constants import PARALLELISM_CONFIG_PREFIX
     from ..utils.environment import get_int_from_env

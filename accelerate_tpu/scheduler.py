@@ -12,7 +12,43 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import numpy as np
+
 from .state import AcceleratorState, GradientState
+
+
+def extract_lr_info(opt_state) -> dict:
+    """Walk an optax opt_state for lr introspection.
+
+    Returns ``{"lr": float}`` when ``optax.inject_hyperparams`` exposes a
+    ``learning_rate`` entry (empty dict otherwise). This is what lets
+    ``get_last_lr`` report a real value for schedules embedded in the optax
+    chain instead of returning ``None`` (reference analog: scheduler.py:69-98
+    reads the torch scheduler's own state)."""
+    found: dict = {}
+
+    def _walk(node):
+        if found or node is None or isinstance(node, (int, float, str, bytes, np.ndarray)):
+            return
+        hyper = getattr(node, "hyperparams", None)
+        if isinstance(hyper, dict) and "learning_rate" in hyper:
+            try:
+                found["lr"] = float(np.asarray(hyper["learning_rate"]))
+                return
+            except (TypeError, ValueError):
+                pass
+        if isinstance(node, dict):
+            for v in node.values():
+                _walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                _walk(v)
+
+    try:
+        _walk(opt_state)
+    except Exception:  # introspection must never break training
+        pass
+    return found
 
 
 class AcceleratedScheduler:
@@ -53,10 +89,25 @@ class AcceleratedScheduler:
                 self._step_count += 1
 
     def get_last_lr(self):
-        try:
-            return float(self.scheduler(self._step_count))
-        except TypeError:
-            return None
+        """Last lr, reference-parity (src/accelerate/scheduler.py:69-98).
+
+        Callable schedules are evaluated at the wrapper's step count; constant
+        lrs are returned as-is; anything else falls back to introspecting the
+        bound optimizers' opt_state (``extract_lr_info``) so optax-chain-
+        embedded schedules still report a value instead of ``None``.
+        """
+        if callable(self.scheduler):
+            try:
+                return float(np.asarray(self.scheduler(self._step_count)))
+            except TypeError:
+                pass
+        if isinstance(self.scheduler, (int, float)):
+            return float(self.scheduler)
+        for opt in self.optimizers:
+            info = extract_lr_info(getattr(opt, "state", None))
+            if "lr" in info:
+                return info["lr"]
+        return None
 
     def state_dict(self):
         return {"step_count": self._step_count}

@@ -56,7 +56,10 @@ MFU_TARGET = 0.45  # BASELINE.md contract: >=45% MFU
 #      any kill point leaves the latest state as the last line of the tail.
 #   2. The supervisor deadline must fit inside the driver's budget. Default
 #      16 min, overridable via BENCH_DEADLINE_S.
-DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", 16 * 60))
+try:
+    DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "") or 16 * 60)
+except ValueError:  # a malformed knob must not erase all evidence at import
+    DEADLINE_S = 16 * 60
 
 
 def _emit(value: float, unit: str, vs_baseline: float, **extra) -> dict:

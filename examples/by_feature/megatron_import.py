@@ -25,7 +25,7 @@ def main():
         LlamaConfig,
         LlamaForCausalLM,
         load_megatron_checkpoint,
-        megatron_core_params_to_llama,
+        megatron_params_to_llama,
         merge_megatron_tp_shards,
     )
 
@@ -75,7 +75,7 @@ def main():
     loaded_shards, meg_args = load_megatron_checkpoint(root)
     assert meg_args["tensor_model_parallel_size"] == 2
     merged = merge_megatron_tp_shards(loaded_shards)
-    params = jax.tree.map(jnp.asarray, megatron_core_params_to_llama(cfg, merged))
+    params = jax.tree.map(jnp.asarray, megatron_params_to_llama(cfg, merged))
     imported = Model(module=module, params=params)
 
     got = np.asarray(imported(ids))

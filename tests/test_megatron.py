@@ -128,12 +128,195 @@ def test_megatron_qkv_bias_roundtrip():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
-def test_megatron_pp_checkpoint_rejected(tmp_path):
+def test_megatron_pp_dirs_without_files_raise(tmp_path):
+    """PP-sharded dirs now LOAD (round 4); empty rank dirs still fail loudly."""
     pytest.importorskip("torch")
     from accelerate_tpu.models.megatron import load_megatron_checkpoint
 
     (tmp_path / "iter_0000005" / "mp_rank_00_000").mkdir(parents=True)
     (tmp_path / "iter_0000005" / "mp_rank_00_001").mkdir(parents=True)
     (tmp_path / "latest_checkpointed_iteration.txt").write_text("5")
-    with pytest.raises(NotImplementedError, match="pipeline-parallel"):
+    with pytest.raises(FileNotFoundError, match="mp_rank_00_000"):
+        load_megatron_checkpoint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# round 4: legacy layout + PP-sharded checkpoint dirs
+# ---------------------------------------------------------------------------
+
+
+def _core_to_legacy_names(sd):
+    """Rename a core flat dict to the legacy language_model.encoder.* layout
+    (inverse of megatron_legacy_to_core, for synthetic-checkpoint tests)."""
+    out = {}
+    for k, v in sd.items():
+        name = k
+        name = name.replace("decoder.layers.", "encoder.layers.")
+        name = name.replace(".self_attention.linear_qkv.layer_norm_weight", "#ILN#")
+        name = name.replace(".mlp.linear_fc1.layer_norm_weight", "#PLN#")
+        name = name.replace(".self_attention.linear_qkv.", ".self_attention.query_key_value.")
+        name = name.replace(".self_attention.linear_proj.", ".self_attention.dense.")
+        name = name.replace(".mlp.linear_fc1.", ".mlp.dense_h_to_4h.")
+        name = name.replace(".mlp.linear_fc2.", ".mlp.dense_4h_to_h.")
+        name = name.replace("#ILN#", ".input_layernorm.weight")
+        name = name.replace("#PLN#", ".post_attention_layernorm.weight")
+        name = name.replace("decoder.final_layernorm.", "encoder.final_layernorm.")
+        if name.startswith("encoder.") or name.startswith("embedding.") or name.startswith(
+            "output_layer."
+        ):
+            name = "language_model." + name
+        out[name] = v
+    return out
+
+
+def test_megatron_legacy_import_logit_parity():
+    """legacy language_model.encoder.* layout converts with logit parity."""
+    from accelerate_tpu.models.megatron import megatron_params_to_llama
+
+    cfg, module, params, ids = _native_llama(gqa=True)
+    want = module.apply({"params": params}, ids)
+    legacy = _core_to_legacy_names(llama_params_to_megatron_core(cfg, params))
+    assert any(k.startswith("language_model.encoder.") for k in legacy)
+    got_params = megatron_params_to_llama(cfg, legacy)
+    got = module.apply({"params": jax.tree.map(jnp.asarray, got_params)}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_legacy_position_embeddings_rejected():
+    from accelerate_tpu.models.megatron import megatron_legacy_to_core
+
+    sd = {"language_model.embedding.position_embeddings.weight": np.zeros((4, 8))}
+    with pytest.raises(ValueError, match="position embeddings"):
+        megatron_legacy_to_core(sd)
+
+
+def test_megatron_pp_sharded_checkpoint_loads(tmp_path):
+    """mp_rank_XX_YYY dirs: stages renumber + union; logit parity end-to-end;
+    the tied word_embeddings_for_head copy on the last stage is dropped."""
+    torch = pytest.importorskip("torch")
+    from accelerate_tpu.models.megatron import (
+        load_megatron_checkpoint,
+        megatron_params_to_llama,
+    )
+
+    cfg, module, params, ids = _native_llama(gqa=False)  # 2 layers -> pp=2
+    want = module.apply({"params": params}, ids)
+    sd = _core_to_legacy_names(llama_params_to_megatron_core(cfg, params))
+
+    def stage_dict(stage):
+        out = {}
+        for k, v in sd.items():
+            m = __import__("re").match(
+                r"(language_model\.encoder\.layers\.)(\d+)(\..+)", k
+            )
+            if m:
+                idx = int(m.group(2))
+                if idx == stage:  # one layer per stage
+                    out[f"{m.group(1)}0{m.group(3)}"] = v
+            elif k.startswith("language_model.embedding."):
+                if stage == 0:
+                    out[k] = v
+            else:  # final norm / output layer -> last stage
+                if stage == 1:
+                    out[k] = v
+        if stage == 1:
+            # Megatron's tied-embedding copy on the last PP stage
+            out["word_embeddings_for_head.word_embeddings.weight"] = sd[
+                "language_model.embedding.word_embeddings.weight"
+            ]
+        return out
+
+    it = tmp_path / "iter_0000007"
+    for pp in range(2):
+        d = it / f"mp_rank_00_{pp:03d}"
+        d.mkdir(parents=True)
+        payload = {
+            "model": {
+                k: torch.from_numpy(np.ascontiguousarray(v))
+                for k, v in stage_dict(pp).items()
+            },
+            "checkpoint_version": 3.0,
+        }
+        torch.save(payload, d / "model_optim_rng.pt")
+    (tmp_path / "latest_checkpointed_iteration.txt").write_text("7")
+
+    shards, _ = load_megatron_checkpoint(str(tmp_path))
+    assert len(shards) == 1
+    merged = merge_megatron_tp_shards(shards)
+    assert not any("word_embeddings_for_head" in k for k in merged)
+    got_params = megatron_params_to_llama(cfg, merged)
+    got = module.apply({"params": jax.tree.map(jnp.asarray, got_params)}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_pp_tp_sharded_checkpoint_loads(tmp_path):
+    """TP=2 x PP=2 grid of mp_rank_0T_00P dirs loads, merges, converts."""
+    torch = pytest.importorskip("torch")
+    from accelerate_tpu.models.megatron import (
+        load_megatron_checkpoint,
+        megatron_params_to_llama,
+    )
+    import re as _re
+
+    cfg, module, params, ids = _native_llama(gqa=False)
+    want = module.apply({"params": params}, ids)
+    sd = llama_params_to_megatron_core(cfg, params)
+
+    def tp_split(name, arr):
+        if name.endswith("linear_fc1.weight"):
+            gate, up = np.split(arr, 2, axis=0)
+            g0, g1 = np.split(gate, 2, axis=0)
+            u0, u1 = np.split(up, 2, axis=0)
+            return [np.concatenate([g0, u0]), np.concatenate([g1, u1])]
+        if name.endswith("linear_qkv.weight") or name.endswith(
+            "word_embeddings.weight"
+        ) or name.endswith("output_layer.weight"):
+            return np.split(arr, 2, axis=0)
+        if name.endswith("linear_proj.weight") or name.endswith("linear_fc2.weight"):
+            return np.split(arr, 2, axis=1)
+        return [arr, arr]
+
+    it = tmp_path / "iter_0000003"
+    for tp in range(2):
+        for pp in range(2):
+            d = it / f"mp_rank_{tp:02d}_{pp:03d}"
+            d.mkdir(parents=True)
+            stage = {}
+            for k, v in sd.items():
+                m = _re.match(r"(decoder\.layers\.)(\d+)(\..+)", k)
+                local = tp_split(k, v)[tp]
+                if m:
+                    if int(m.group(2)) == pp:
+                        stage[f"{m.group(1)}0{m.group(3)}"] = local
+                elif k.startswith("embedding."):
+                    if pp == 0:
+                        stage[k] = local
+                elif pp == 1:
+                    stage[k] = local
+            torch.save(
+                {"model": {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in stage.items()},
+                 "checkpoint_version": 3.0},
+                d / "model_optim_rng.pt",
+            )
+    (tmp_path / "latest_checkpointed_iteration.txt").write_text("3")
+
+    shards, _ = load_megatron_checkpoint(str(tmp_path))
+    assert len(shards) == 2
+    got_params = megatron_params_to_llama(cfg, merge_megatron_tp_shards(shards))
+    got = module.apply({"params": jax.tree.map(jnp.asarray, got_params)}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_megatron_old_checkpoint_version_rejected(tmp_path):
+    torch = pytest.importorskip("torch")
+    from accelerate_tpu.models.megatron import load_megatron_checkpoint
+
+    d = tmp_path / "iter_0000001" / "mp_rank_00"
+    d.mkdir(parents=True)
+    torch.save(
+        {"model": {"x": torch.zeros(2)}, "checkpoint_version": 0},
+        d / "model_optim_rng.pt",
+    )
+    (tmp_path / "latest_checkpointed_iteration.txt").write_text("1")
+    with pytest.raises(NotImplementedError, match="checkpoint_version"):
         load_megatron_checkpoint(str(tmp_path))

@@ -248,3 +248,127 @@ def test_prepare_rejects_dispatched_model():
     acc = Accelerator()
     with _pytest.raises(ValueError, match="device_map"):
         acc.prepare(split, optax.sgd(1e-3))
+
+
+def test_prepare_optimizer_adjacency_pairing():
+    """Round-4 advisor (medium): prepare(frozen_teacher, student, tx) must
+    bind tx to the *student* (nearest preceding model), not models[0]."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    teacher = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(0), ids)
+    student = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(1), ids)
+    acc = Accelerator()
+    teacher, student, opt = acc.prepare(teacher, student, optax.adam(1e-3))
+    # tx bound to the student's slot, leaving the teacher optimizer-less.
+    assert opt._state_slot == student._state_slot
+    assert acc._train_states[student._state_slot or 0].tx is not None
+    t_state = acc._train_states[teacher._state_slot or 0]
+    assert t_state.opt_state is None or t_state.tx is None
+
+
+def test_prepare_optimizer_pairing_ambiguity_raises():
+    """Two optimizers after the same model is ambiguous -> ValueError; an
+    optimizer before any model -> ValueError."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    m = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(0), ids)
+    acc = Accelerator()
+    with pytest.raises(ValueError, match="ambiguous"):
+        acc.prepare(m, optax.adam(1e-3), optax.sgd(1e-3))
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    m2 = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(0), ids)
+    acc2 = Accelerator()
+    with pytest.raises(ValueError, match="before any model"):
+        acc2.prepare(optax.adam(1e-3), m2)
+
+
+def test_pp_virtual_stages_explicit_validation():
+    """Round-4 advisor (low): explicit virtual_stages=0/-1 must raise, not
+    silently fall back to the plain GPipe schedule."""
+    from accelerate_tpu.parallel.pp import _resolve_virtual_stages
+
+    with pytest.raises(ValueError, match="virtual_stages"):
+        _resolve_virtual_stages(0)
+    with pytest.raises(ValueError, match="virtual_stages"):
+        _resolve_virtual_stages(-2)
+    assert _resolve_virtual_stages(2) == 2
+
+
+def test_cp_generate_zero_new_tokens_returns_prompt():
+    """Round-4 advisor (low): max_new_tokens=0 returns the prompt unchanged
+    (the documented (B, S + max_new_tokens) contract), matching generate()."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Model
+    from accelerate_tpu.cp_generation import cp_generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    model = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(0), ids)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("cp",))
+    out = cp_generate(model, ids, 0, mesh=mesh)
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(np.asarray(out), ids)
+
+
+def test_scheduler_get_last_lr_fallbacks():
+    """Round-4 VERDICT weak#7: get_last_lr must report a value for constant
+    lrs and optax-chain-embedded (inject_hyperparams) schedules, not None."""
+    import optax
+
+    from accelerate_tpu.scheduler import AcceleratedScheduler, extract_lr_info
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    from accelerate_tpu import Accelerator
+
+    Accelerator()  # AcceleratorState for num_processes
+
+    # callable schedule: evaluated at the wrapper count
+    sched = AcceleratedScheduler(optax.linear_schedule(1e-3, 0.0, 100))
+    assert sched.get_last_lr() == pytest.approx(1e-3)
+    # constant lr
+    assert AcceleratedScheduler(3e-4).get_last_lr() == pytest.approx(3e-4)
+
+    # embedded in the chain via inject_hyperparams: read from opt_state
+    tx = optax.inject_hyperparams(optax.sgd)(
+        learning_rate=optax.linear_schedule(2e-3, 0.0, 10)
+    )
+    import jax.numpy as jnp
+
+    params = {"w": jnp.ones((2,))}
+    state = tx.init(params)
+    info = extract_lr_info(state)
+    assert info.get("lr") == pytest.approx(2e-3)
+
+    class FakeOpt:
+        pass
+
+    opt = FakeOpt()
+    opt.state = state
+    wrapped = AcceleratedScheduler(object(), optimizers=[opt])
+    assert wrapped.get_last_lr() == pytest.approx(2e-3)
