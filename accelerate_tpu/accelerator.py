@@ -73,7 +73,7 @@ from .utils import (
     save_sharded_safetensors,
     set_seed,
 )
-from .utils.dataclasses import KwargsHandler, ProfileKwargs
+from .utils.dataclasses import DistributedDataParallelKwargs, KwargsHandler, ProfileKwargs
 
 logger = get_logger(__name__)
 
@@ -102,6 +102,26 @@ def _is_dataloader_like(obj) -> bool:
 
 def _is_schedule(obj) -> bool:
     return callable(obj) and not _is_optax_tx(obj) and not isinstance(obj, Model) and not _is_dataloader_like(obj)
+
+
+def _microbatch_split(batch, num_accum: int, what: str = "Batch"):
+    """(B, ...) → (accum, B/accum, ...) without moving data across devices:
+    the batch dim stays dp-sharded on the first reshaped dim (each device's
+    contiguous block is a multiple of accum), the transpose is a layout
+    change. Shared by the normal and comm-hook train steps — their
+    accumulation semantics must never diverge."""
+
+    def _split(x):
+        b = x.shape[0]
+        if b % num_accum != 0:
+            raise ValueError(
+                f"{what} dim {b} not divisible by gradient "
+                f"accumulation steps {num_accum}."
+            )
+        x = x.reshape(b // num_accum, num_accum, *x.shape[1:])
+        return jnp.swapaxes(x, 0, 1)
+
+    return jax.tree.map(_split, batch)
 
 
 class _HookHandle:
@@ -152,6 +172,7 @@ class Accelerator:
         self.scaler_handler = None
         self.profile_handler = None
         self.fp8_recipe_handler = None
+        self.ddp_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -159,6 +180,8 @@ class Accelerator:
                 self.profile_handler = handler
             elif isinstance(handler, FP8RecipeKwargs):
                 self.fp8_recipe_handler = handler
+            elif isinstance(handler, DistributedDataParallelKwargs):
+                self.ddp_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -1146,24 +1169,29 @@ class Accelerator:
                 loss_scale=new_scale,
             ), gnorm
 
+        comm_hook = (
+            getattr(self.ddp_handler, "comm_hook", "no")
+            if self.ddp_handler is not None
+            else "no"
+        ) or "no"
+        if comm_hook != "no":
+            return self._comm_hook_step(
+                loss_fn,
+                slot=slot,
+                comm_hook=comm_hook,
+                policy=policy,
+                num_accum=num_accum,
+                update_fn=_update,
+                donate=donate,
+                has_aux=has_aux,
+                mutable_state=mutable_state,
+                grad_shardings=grad_shardings,
+            )
+
         if num_accum > 1:
 
             def step(state: TrainState, batch):
-                def _split_micro(x):
-                    # (B, ...) → (accum, B/accum, ...) without moving data
-                    # across devices: the batch dim stays dp-sharded on the
-                    # first reshaped dim (each device's contiguous block is a
-                    # multiple of accum), the transpose is a layout change.
-                    b = x.shape[0]
-                    if b % num_accum != 0:
-                        raise ValueError(
-                            f"Batch dim {b} not divisible by gradient "
-                            f"accumulation steps {num_accum}."
-                        )
-                    x = x.reshape(b // num_accum, num_accum, *x.shape[1:])
-                    return jnp.swapaxes(x, 0, 1)
-
-                batch = jax.tree.map(_split_micro, batch)
+                batch = _microbatch_split(batch, num_accum)
 
                 def body(carry, microbatch):
                     grads_acc, loss_acc, extra = carry
@@ -1204,6 +1232,160 @@ class Accelerator:
             # Keep the accelerator's view current: with buffer donation the
             # previous state's arrays are dead after this call, so save_state,
             # Model.__call__ and trackers must see the new one.
+            self._train_states[slot] = new_state
+            return new_state, metrics
+
+        return step_and_track
+
+    def _comm_hook_step(
+        self,
+        loss_fn,
+        *,
+        slot: int,
+        comm_hook: str,
+        policy,
+        num_accum: int,
+        update_fn,
+        donate: bool,
+        has_aux: bool,
+        mutable_state: bool,
+        grad_shardings,
+    ):
+        """Build a train step whose DP gradient sync runs through a
+        compression comm hook (``DistributedDataParallelKwargs.comm_hook``,
+        reference: utils/dataclasses.py:157-241).
+
+        GSPMD normally places the gradient ``psum`` itself, so to *replace*
+        it the gradients are computed under ``shard_map`` over the DP axes
+        (manual collectives) and reduced by
+        :func:`parallel.comm_hooks.make_comm_hook_reducer` — fp16/bf16 wire
+        compression or PowerSGD low-rank + error feedback. Hook state (the
+        PowerSGD Q factors and error buffers) threads through the returned
+        step in a host-side holder, one entry per prepared model slot.
+
+        DDP semantics only: replicated params, pure data-parallel mesh.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from .parallel.comm_hooks import init_powersgd_state, make_comm_hook_reducer
+
+        if mutable_state or has_aux:
+            raise NotImplementedError(
+                "comm_hook is not supported together with mutable_state/has_aux"
+            )
+        if grad_shardings is not None:
+            raise ValueError(
+                "comm_hook requires replicated (DDP) gradients — it cannot "
+                "compose with ZeRO-2 SHARD_GRAD_OP reduce-scatter"
+            )
+        mesh = self.mesh
+        dp_axes = tuple(
+            a for a in ("dp_replicate", "dp_shard") if mesh.shape.get(a, 1) > 1
+        )
+        bad = [
+            a for a, s in mesh.shape.items()
+            if a not in ("dp_replicate", "dp_shard") and s > 1
+        ]
+        if bad:
+            raise ValueError(
+                f"comm_hook requires a pure data-parallel mesh; axes {bad} have "
+                "size > 1 (the reference's DDP comm hooks are DP-only too)"
+            )
+        params0 = self._train_states[slot].params
+        for leaf in jax.tree.leaves(params0):
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            if spec is not None and any(ax is not None for ax in spec):
+                raise ValueError(
+                    "comm_hook requires replicated (DDP) parameters; param "
+                    f"sharded as {spec} — drop the FSDP plugin or the hook"
+                )
+        rank = int(getattr(self.ddp_handler, "powersgd_rank", 8))
+        reducer = make_comm_hook_reducer(comm_hook, dp_axes, rank=rank)
+        if comm_hook == "powersgd":
+            comm_state0 = init_powersgd_state(params0, rank)
+        else:
+            comm_state0 = jax.tree.map(lambda _: {}, params0)
+
+        rep = lambda tree: jax.tree.map(  # noqa: E731 - local spec builder
+            lambda x: P(*([None] * jnp.ndim(x))), tree
+        )
+
+        def hook_step(state: TrainState, batch, comm_state):
+            loss_scale = state.loss_scale
+
+            def local(params, batch, comm_state):
+                def _fn(p, mb):
+                    loss = loss_fn(policy.cast_for_compute(p), mb)
+                    scale = loss_scale.scale if loss_scale is not None else 1.0
+                    return (loss * scale).astype(jnp.float32), loss
+
+                gfn = jax.value_and_grad(_fn, has_aux=True)
+                if num_accum > 1:
+                    micro = _microbatch_split(batch, num_accum, what="Per-device batch")
+
+                    def body(carry, mb):
+                        gacc, lacc = carry
+                        (_, loss), g = gfn(params, mb)
+                        return (jax.tree.map(jnp.add, gacc, g), lacc + loss), None
+
+                    zeros = jax.tree.map(jnp.zeros_like, params)
+                    (grads, loss_sum), _ = jax.lax.scan(
+                        body, (zeros, jnp.asarray(0.0, jnp.float32)), micro
+                    )
+                    # DDP no_sync semantics: accumulate locally, reduce ONCE
+                    # at the boundary — the hook fires once per optimizer
+                    # step, exactly like the reference's bucket hooks.
+                    grads = jax.tree.map(lambda g: g / num_accum, grads)
+                    loss = loss_sum / num_accum
+                else:
+                    (_, loss), grads = gfn(params, batch)
+                # Reduce in TRUE gradient units: under fp16 dynamic loss
+                # scaling the raw grads carry the scale factor, and PowerSGD's
+                # error-feedback buffers must not inherit it (a scale change
+                # would corrupt the carried residual by the same factor).
+                scale = loss_scale.scale if loss_scale is not None else None
+                if scale is not None:
+                    grads = jax.tree.map(lambda g: g / scale, grads)
+                finite = grads_all_finite(grads)
+                grads, new_comm = reducer(grads, comm_state)
+                # An overflowed step (inf grads -> NaN through qr) must not
+                # poison the persistent hook state: keep the previous one.
+                new_comm = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_comm, comm_state
+                )
+                if scale is not None:
+                    # update_fn unscales again — hand back scaled grads so the
+                    # hooked and unhooked paths share one _update.
+                    grads = jax.tree.map(lambda g: g * scale, grads)
+                for ax in dp_axes:
+                    loss = jax.lax.pmean(loss, ax)
+                return loss, grads, new_comm
+
+            batch_specs = jax.tree.map(
+                lambda x: P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
+                if dp_axes
+                else P(*([None] * jnp.ndim(x))),
+                batch,
+            )
+            loss, grads, new_comm = jax.shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(rep(state.params), batch_specs, rep(comm_state)),
+                out_specs=(P(), rep(state.params), rep(comm_state)),
+                check_vma=False,
+            )(state.params, batch, comm_state)
+            new_state, gnorm = update_fn(state, grads)
+            return new_state, {"loss": loss, "grad_norm": gnorm}, new_comm
+
+        # Donate the comm state too: the PowerSGD error buffers are
+        # params-sized fp32 — updating them in place matters.
+        jitted = jax.jit(hook_step, donate_argnums=(0, 2) if donate else ())
+        holder = {"comm_state": comm_state0}
+
+        def step_and_track(state: TrainState, batch):
+            new_state, metrics, holder["comm_state"] = jitted(
+                state, batch, holder["comm_state"]
+            )
             self._train_states[slot] = new_state
             return new_state, metrics
 

@@ -419,6 +419,14 @@ def _pin_last_dim_replicated(x):
     mesh = AcceleratorState._shared_state.get("_mesh")
     if mesh is None or getattr(x, "ndim", 0) < 2:
         return x
+    from jax.sharding import AxisType, get_abstract_mesh
+
+    ambient = get_abstract_mesh()
+    if ambient is not None and any(t == AxisType.Manual for t in ambient.axis_types):
+        # Inside shard_map (manual axes) — e.g. a comm-hook step or the
+        # GPipe stage body — sharding constraints don't apply (and raise);
+        # the caller already controls the layout by hand.
+        return x
     if mesh.shape.get("pp", 1) > 1:
         # Under GPipe the last stage computes the unembed inside shard_map
         # with its own stage-local layout; pinning the collected logits on

@@ -177,16 +177,22 @@ class InitProcessGroupKwargs(KwargsHandler):
 
 @dataclass
 class DistributedDataParallelKwargs(KwargsHandler):
-    """Accepted for API parity (reference: utils/dataclasses.py:157-241).
-    Under GSPMD there is no DDP reducer to configure — gradient mean is a
-    single psum the compiler schedules — so these knobs are advisory no-ops
-    except ``gradient_as_bucket_view``-style memory hints."""
+    """Reference: utils/dataclasses.py:157-241. Under GSPMD there is no DDP
+    reducer to configure — gradient mean is a single psum the compiler
+    schedules — so the bucketing knobs are advisory no-ops. ``comm_hook``
+    IS live: it routes the step through a ``shard_map``-controlled gradient
+    sync (parallel/comm_hooks.py) replacing the psum with fp16/bf16 wire
+    compression or PowerSGD rank-``powersgd_rank`` low-rank reduction with
+    error feedback — for DCN-spanning data-parallel meshes where the grad
+    all-reduce can't hide behind compute. DDP (replicated-param) meshes
+    only; pass via ``Accelerator(kwargs_handlers=[...])``."""
 
     bucket_cap_mb: int = 25
     find_unused_parameters: bool = False
     gradient_as_bucket_view: bool = False
     static_graph: bool = False
-    comm_hook: str = "no"  # no | fp16 | bf16 — compress grads before psum
+    comm_hook: str = "no"  # no | fp16 | bf16 | powersgd
+    powersgd_rank: int = 8  # reference: matrix_approximation_rank state option
 
 
 @dataclass
