@@ -1301,13 +1301,34 @@ class Accelerator:
                 )
         rank = int(getattr(self.ddp_handler, "powersgd_rank", 8))
         reducer = make_comm_hook_reducer(comm_hook, dp_axes, rank=rank)
+        dp_total = 1
+        for a in dp_axes:
+            dp_total *= mesh.shape[a]
         if comm_hook == "powersgd":
-            comm_state0 = init_powersgd_state(params0, rank)
+            comm_state0 = init_powersgd_state(params0, rank, dp_size=dp_total)
         else:
             comm_state0 = jax.tree.map(lambda _: {}, params0)
 
         rep = lambda tree: jax.tree.map(  # noqa: E731 - local spec builder
             lambda x: P(*([None] * jnp.ndim(x))), tree
+        )
+        # Hook-state specs: Q factors are pmean'd (honestly replicated); the
+        # error-feedback buffers are per-worker and SHARDED on their leading
+        # dp axis (see init_powersgd_state's docstring for why a replicated
+        # claim would be a silent-corruption hazard).
+        _params_treedef = jax.tree_util.tree_structure(params0)
+        _entries = _params_treedef.flatten_up_to(comm_state0)
+        comm_specs = jax.tree_util.tree_unflatten(
+            _params_treedef,
+            [
+                {}
+                if not e
+                else {
+                    "q": P(None, None),
+                    "e": P(dp_axes, None, None) if dp_axes else P(None, None, None),
+                }
+                for e in _entries
+            ],
         )
 
         def hook_step(state: TrainState, batch, comm_state):
@@ -1339,12 +1360,16 @@ class Accelerator:
                     loss = loss_sum / num_accum
                 else:
                     (_, loss), grads = gfn(params, batch)
-                # Reduce in TRUE gradient units: under fp16 dynamic loss
-                # scaling the raw grads carry the scale factor, and PowerSGD's
-                # error-feedback buffers must not inherit it (a scale change
-                # would corrupt the carried residual by the same factor).
+                # PowerSGD reduces in TRUE gradient units: its error-feedback
+                # buffers must not inherit the fp16 loss-scale factor (a
+                # scale change would corrupt the carried residual by that
+                # factor). fp16/bf16 wire hooks do the OPPOSITE — they
+                # compress the still-scaled gradient, exactly like the
+                # reference's fp16_compress_hook: the scale is what keeps
+                # ~1e-6 grads above fp16's min normal on the wire.
+                unscale = comm_hook == "powersgd"
                 scale = loss_scale.scale if loss_scale is not None else None
-                if scale is not None:
+                if unscale and scale is not None:
                     grads = jax.tree.map(lambda g: g / scale, grads)
                 finite = grads_all_finite(grads)
                 grads, new_comm = reducer(grads, comm_state)
@@ -1353,7 +1378,7 @@ class Accelerator:
                 new_comm = jax.tree.map(
                     lambda n, o: jnp.where(finite, n, o), new_comm, comm_state
                 )
-                if scale is not None:
+                if unscale and scale is not None:
                     # update_fn unscales again — hand back scaled grads so the
                     # hooked and unhooked paths share one _update.
                     grads = jax.tree.map(lambda g: g * scale, grads)
@@ -1370,8 +1395,8 @@ class Accelerator:
             loss, grads, new_comm = jax.shard_map(
                 local,
                 mesh=mesh,
-                in_specs=(rep(state.params), batch_specs, rep(comm_state)),
-                out_specs=(P(), rep(state.params), rep(comm_state)),
+                in_specs=(rep(state.params), batch_specs, comm_specs),
+                out_specs=(P(), rep(state.params), comm_specs),
                 check_vma=False,
             )(state.params, batch, comm_state)
             new_state, gnorm = update_fn(state, grads)

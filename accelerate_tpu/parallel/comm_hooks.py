@@ -48,11 +48,17 @@ def _compressible(g, rank: int) -> bool:
     return min(n, m) > rank and rank * (n + m) < n * m
 
 
-def init_powersgd_state(params, rank: int, seed: int = 0):
-    """Per-compressible-leaf ``{"q": (m, r) start vectors, "e": (n, m) error
-    feedback}``; non-compressible leaves get an empty dict. Q starts from a
-    fixed-seed normal so every DP rank holds identical state (the reduction
-    keeps it in sync thereafter)."""
+def init_powersgd_state(params, rank: int, dp_size: int = 1, seed: int = 0):
+    """Per-compressible-leaf ``{"q": (m, r) start vectors, "e": (dp, n, m)
+    error feedback}``; non-compressible leaves get an empty dict.
+
+    Q starts from a fixed-seed normal and STAYS identical on every DP rank
+    (each update is pmean'd). The error feedback is genuinely per-worker —
+    ``e_new = local_grad + e - approx`` diverges across ranks by design
+    (Vogels et al. §3) — so it carries an explicit leading ``dp`` axis and is
+    declared SHARDED over the DP mesh axes, never replicated: a dishonest
+    replication claim would let any relayout/checkpoint silently collapse
+    all workers' residuals to rank 0's copy."""
     flat, treedef = jax.tree_util.tree_flatten(params)
     keys = jax.random.split(jax.random.key(seed), max(1, len(flat)))
     states = []
@@ -61,7 +67,7 @@ def init_powersgd_state(params, rank: int, seed: int = 0):
             n, m = _matrix_shape(p)
             states.append({
                 "q": jax.random.normal(keys[i], (m, rank), jnp.float32),
-                "e": jnp.zeros((n, m), jnp.float32),
+                "e": jnp.zeros((dp_size, n, m), jnp.float32),
             })
         else:
             states.append({})
@@ -108,11 +114,16 @@ def make_comm_hook_reducer(comm_hook: str, axis_names: tuple, rank: int = 8):
                 return _pmean(g), st
             shape, dtype = g.shape, g.dtype
             n, m = _matrix_shape(g)
-            mat = g.reshape(n, m).astype(jnp.float32) + st["e"]
+            # st["e"] arrives as this worker's slice of the (dp, n, m) error
+            # buffer: leading dim 1 inside shard_map (or dp==1 standalone).
+            mat = g.reshape(n, m).astype(jnp.float32) + st["e"][0]
             p = _orthonormalize(_pmean(mat @ st["q"]))
             q_new = _pmean(mat.T @ p)
             approx = p @ q_new.T
-            return approx.reshape(shape).astype(dtype), {"q": q_new, "e": mat - approx}
+            return approx.reshape(shape).astype(dtype), {
+                "q": q_new,
+                "e": (mat - approx)[None],
+            }
 
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_s = treedef.flatten_up_to(comm_state)

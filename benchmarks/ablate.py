@@ -104,11 +104,13 @@ def main():
         keep = args.variants.split(",")
         variants = {k: v for k, v in variants.items() if k in keep}
     for name, kw in variants.items():
+        # flush per row (streaming-evidence rule, round-3 postmortem): a
+        # driver timeout mid-sweep must keep every finished variant's number.
         try:
             tok, loss = measure(args.seq, args.iters, **kw)
-            print(f"{name:28s} {tok:10.1f} tok/s/chip   loss {loss:.4f}")
+            print(f"{name:28s} {tok:10.1f} tok/s/chip   loss {loss:.4f}", flush=True)
         except Exception as e:
-            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:200]}")
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:200]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -58,6 +58,11 @@ def main():
     ap.add_argument("--streamed-tokens", type=int, default=4)
     args = ap.parse_args()
 
+    # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
+    # parseable row the moment anything is known, flushed — a driver timeout
+    # must never leave an empty tail.
+    print(json.dumps({"row": "start", "params_b": args.params_b}), flush=True)
+
     import jax
     import jax.numpy as jnp
 
@@ -97,7 +102,7 @@ def main():
     print(json.dumps({
         "row": "load", "seconds": round(load_s, 2),
         "params_b": round(n_params / 1e9, 3), "device_kind": device_kind,
-    }))
+    }), flush=True)
 
     # --- Row 2: resident KV-cache decode ----------------------------------
     # device_map=None placed every param on chip 0; reuse that tree directly.
@@ -119,7 +124,7 @@ def main():
         "warm_generate_s": round(warm_s, 3),
         "first_call_s": round(first_s, 2),
         "new_tokens": args.new_tokens,
-    }))
+    }), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)
@@ -138,7 +143,7 @@ def main():
         "row": "streamed", "s_per_token": round(float(np.mean(times[1:] or times)), 3),
         "hbm_resident_bytes": int(streamed.hbm_resident_bytes()),
         "tokens": args.streamed_tokens,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

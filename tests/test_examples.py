@@ -86,6 +86,7 @@ _BY_FEATURE_OK = {
     "fsdp_with_peak_mem_tracking.py": "fsdp peak-mem OK",
     "long_context_generation.py": "long-context generation OK",
     "distillation.py": "distillation OK",
+    "ddp_comm_hook.py": "ddp_comm_hook OK",
 }
 
 
@@ -153,6 +154,7 @@ _FEATURE_MARKERS = {
     "fsdp_with_peak_mem_tracking.py": ["FullyShardedDataParallelPlugin", "memory_stats"],
     "long_context_generation.py": ["cp_generate"],
     "distillation.py": ["model=student", "_state_slot"],
+    "ddp_comm_hook.py": ["DistributedDataParallelKwargs", "comm_hook"],
 }
 
 
