@@ -1305,7 +1305,9 @@ class Accelerator:
         for a in dp_axes:
             dp_total *= mesh.shape[a]
         if comm_hook == "powersgd":
-            comm_state0 = init_powersgd_state(params0, rank, dp_size=dp_total)
+            comm_state0 = init_powersgd_state(
+                params0, rank, dp_size=dp_total, mesh=mesh, dp_axes=dp_axes
+            )
         else:
             comm_state0 = jax.tree.map(lambda _: {}, params0)
 

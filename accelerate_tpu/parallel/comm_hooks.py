@@ -48,7 +48,8 @@ def _compressible(g, rank: int) -> bool:
     return min(n, m) > rank and rank * (n + m) < n * m
 
 
-def init_powersgd_state(params, rank: int, dp_size: int = 1, seed: int = 0):
+def init_powersgd_state(params, rank: int, dp_size: int = 1, seed: int = 0,
+                        mesh=None, dp_axes: tuple = ()):
     """Per-compressible-leaf ``{"q": (m, r) start vectors, "e": (dp, n, m)
     error feedback}``; non-compressible leaves get an empty dict.
 
@@ -58,16 +59,29 @@ def init_powersgd_state(params, rank: int, dp_size: int = 1, seed: int = 0):
     (Vogels et al. §3) — so it carries an explicit leading ``dp`` axis and is
     declared SHARDED over the DP mesh axes, never replicated: a dishonest
     replication claim would let any relayout/checkpoint silently collapse
-    all workers' residuals to rank 0's copy."""
+    all workers' residuals to rank 0's copy.
+
+    Pass ``mesh``/``dp_axes`` to allocate the buffers directly with their
+    target shardings — without it a (dp, n, m) zeros per large leaf would
+    materialize dp× the param footprint on one device before the first step
+    reshards it (params-scale at dp=32 means OOM at init, not at steady
+    state)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    e_dev = q_dev = None
+    if mesh is not None:
+        e_dev = NamedSharding(mesh, P(dp_axes if dp_axes else None, None, None))
+        q_dev = NamedSharding(mesh, P(None, None))
     flat, treedef = jax.tree_util.tree_flatten(params)
     keys = jax.random.split(jax.random.key(seed), max(1, len(flat)))
     states = []
     for i, p in enumerate(flat):
         if _compressible(p, rank):
             n, m = _matrix_shape(p)
+            q = jax.random.normal(keys[i], (m, rank), jnp.float32)
             states.append({
-                "q": jax.random.normal(keys[i], (m, rank), jnp.float32),
-                "e": jnp.zeros((dp_size, n, m), jnp.float32),
+                "q": jax.device_put(q, q_dev) if q_dev is not None else q,
+                "e": jnp.zeros((dp_size, n, m), jnp.float32, device=e_dev),
             })
         else:
             states.append({})

@@ -1,0 +1,82 @@
+"""Feature: token-weighted gradient accumulation for autoregressive models
+(reference: examples/by_feature/gradient_accumulation_for_autoregressive_models.py).
+
+With padded variable-length documents, microbatches carry different numbers
+of real tokens. Averaging per-microbatch MEAN losses (the classifier recipe)
+weights a token in a short-doc microbatch more than one in a long-doc
+microbatch. The fix: each microbatch contributes its token-loss SUM divided
+by ``total_tokens / accum_steps`` — the accumulated gradient is then exactly
+the global per-token mean, independent of how tokens fall into microbatches.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from _base import make_parser
+
+
+def main():
+    parser = make_parser(epochs=1, batch_size=4)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    args = parser.parse_args()
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    accum = args.gradient_accumulation_steps
+    opt_batch = args.batch_size * accum  # rows per optimizer step
+    rng = np.random.default_rng(args.seed)
+    n_docs, seq = 16 * opt_batch, 33
+    ids = rng.integers(1, cfg.vocab_size, size=(n_docs, seq), dtype=np.int32)
+    lengths = rng.integers(8, seq + 1, size=(n_docs,))
+    for i, ln in enumerate(lengths):
+        ids[i, ln:] = 0  # pad id 0 — docs genuinely vary in token count
+
+    model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
+    model, optimizer = accelerator.prepare(model, optax.adamw(args.lr))
+
+    def loss_fn(params, batch):
+        logits = module.apply({"params": params}, batch["x"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        valid = batch["y"] != 0
+        safe = jnp.where(valid, batch["y"], 0)
+        tok = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        # THE feature: normalize this microbatch's token-loss SUM by the
+        # optimizer batch's tokens/accum (batch["norm"], same value on every
+        # row) — NOT by this microbatch's own token count.
+        return jnp.where(valid, tok, 0.0).sum() / batch["norm"][0]
+
+    step_fn = accelerator.prepare_train_step(loss_fn, max_grad_norm=1.0)
+    state = accelerator.train_state
+    losses = []
+    for epoch in range(args.epochs):
+        for start in range(0, n_docs, opt_batch):
+            rows = ids[start : start + opt_batch]
+            x, y = rows[:, :-1], rows[:, 1:]
+            total_tokens = int((y != 0).sum())
+            batch = {
+                "x": x,
+                "y": y,
+                # per-row so the microbatch split can carry it; all rows equal
+                "norm": np.full((opt_batch,), total_tokens / accum, np.float32),
+            }
+            state, metrics = step_fn(state, batch)
+            losses.append(float(np.asarray(metrics["loss"])))
+    accelerator.print(
+        f"auto-regressive grad-accum OK: token-weighted loss "
+        f"{losses[0]:.3f} -> {losses[-1]:.3f} over {len(losses)} steps"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,10 @@ def _reset():
     PartialState._reset_state()
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)  # 3 tests reuse the identical baseline run
 def _train(comm_hook, steps=12, accum=1, rank=8):
     _reset()
     set_seed(0)

@@ -87,6 +87,7 @@ _BY_FEATURE_OK = {
     "long_context_generation.py": "long-context generation OK",
     "distillation.py": "distillation OK",
     "ddp_comm_hook.py": "ddp_comm_hook OK",
+    "gradient_accumulation_for_autoregressive_models.py": "auto-regressive grad-accum OK",
 }
 
 
@@ -155,6 +156,7 @@ _FEATURE_MARKERS = {
     "long_context_generation.py": ["cp_generate"],
     "distillation.py": ["model=student", "_state_slot"],
     "ddp_comm_hook.py": ["DistributedDataParallelKwargs", "comm_hook"],
+    "gradient_accumulation_for_autoregressive_models.py": ["gradient_accumulation_steps", "norm"],
 }
 
 
