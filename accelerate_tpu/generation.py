@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .models.llama import apply_rope, rms_norm, rotary_embedding
+from .utils.quantization import DecodeQuant
 
 
 class KVCache(NamedTuple):
@@ -77,24 +78,36 @@ def init_cache(cfg, batch: int, max_len: int, dtype=None) -> KVCache:
 # ---------------------------------------------------------------------------
 
 
+def _kernel(k, dtype):
+    """A weight in compute dtype. ``DecodeQuant`` (int8 weight-only decode,
+    utils/quantization.py) dequantizes HERE — adjacent to the matmul — so
+    XLA fuses convert×scale into the dot and the weight rides HBM as int8
+    (the bandwidth that dominates batch-1 decode)."""
+    if isinstance(k, DecodeQuant):
+        from .utils.quantization import dequantize_decode_kernel
+
+        return dequantize_decode_kernel(k, dtype)
+    return k.astype(dtype)
+
+
 def _proj(x, kernel):
     # kernel (H, heads, D) — the DenseGeneral layout of models/llama.py.
-    return jnp.einsum("bsh,hnd->bsnd", x, kernel.astype(x.dtype))
+    return jnp.einsum("bsh,hnd->bsnd", x, _kernel(kernel, x.dtype))
 
 
 def _out_proj(x, kernel):
     # kernel (heads, D, H).
-    return jnp.einsum("bsnd,ndh->bsh", x, kernel.astype(x.dtype))
+    return jnp.einsum("bsnd,ndh->bsh", x, _kernel(kernel, x.dtype))
 
 
 def _mlp(cfg, p, x):
-    gate = x @ p["gate_proj"]["kernel"].astype(x.dtype)
-    up = x @ p["up_proj"]["kernel"].astype(x.dtype)
+    gate = x @ _kernel(p["gate_proj"]["kernel"], x.dtype)
+    up = x @ _kernel(p["up_proj"]["kernel"], x.dtype)
     act = (
         jax.nn.silu if getattr(cfg, "hidden_act", "silu") == "silu"
         else partial(jax.nn.gelu, approximate=True)
     )
-    return (act(gate) * up) @ p["down_proj"]["kernel"].astype(x.dtype)
+    return (act(gate) * up) @ _kernel(p["down_proj"]["kernel"], x.dtype)
 
 
 def _norm_w(cfg, w, like):

@@ -128,7 +128,11 @@ def _unpack_int4(packed: jax.Array) -> jax.Array:
     return rows.reshape(-1, packed.shape[-1])                  # (k, n)
 
 
-def dequantize_tensor(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+def dequantize_tensor(qt, dtype=jnp.bfloat16) -> jax.Array:
+    if isinstance(qt, DecodeQuant):
+        return dequantize_decode_kernel(qt, dtype)
+    if not isinstance(qt, QuantizedTensor):
+        raise TypeError(f"not a quantized leaf: {type(qt).__name__}")
     if qt.bits == 8:
         return (qt.data.astype(jnp.float32) * qt.scales).astype(dtype).reshape(qt.shape)
     k = int(np.prod(qt.shape[:-1]))
@@ -141,8 +145,94 @@ def dequantize_tensor(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
     return w.reshape(-1, n)[:k].reshape(qt.shape).astype(dtype)
 
 
+@struct.dataclass
+class DecodeQuant:
+    """Int8 weight-only leaf for the KV-cache decode path.
+
+    Same-shape int8 ``data`` + per-(layer, out-channel) fp32 ``scales``;
+    BOTH fields keep the stacked leading layer dim, so ``lax.scan`` over the
+    block tree slices them together (a :class:`QuantizedTensor`'s broadcast
+    scales can't ride a scan). Dequantization happens at the matmul
+    (``generation._kernel``), so XLA reads int8 from HBM and fuses the
+    scale-multiply into the dot — roughly halving the weight traffic that
+    dominates batch-1 decode.
+    """
+
+    data: jax.Array     # int8, original kernel shape
+    scales: jax.Array   # fp32, (lead, 1, ..., 1, out)
+
+    @property
+    def nbytes_packed(self) -> int:
+        return self.data.nbytes + self.scales.nbytes
+
+
+def quantize_decode_kernel(w: jax.Array) -> DecodeQuant:
+    """Symmetric int8 with per-(leading, last-dim) channel scales — reduce
+    only the middle (input) dims so the layer axis stays scannable."""
+    w32 = jnp.asarray(w, jnp.float32)
+    axes = tuple(range(1, w32.ndim - 1)) or (0,)
+    amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scales), -127, 127).astype(jnp.int8)
+    return DecodeQuant(data=q, scales=scales)
+
+
+def dequantize_decode_kernel(dq: DecodeQuant, dtype=jnp.bfloat16) -> jax.Array:
+    return (dq.data.astype(jnp.float32) * dq.scales).astype(dtype)
+
+
+def quantize_model_for_decode(model):
+    """Return an inference-only copy of ``model`` whose stacked block
+    kernels are int8 :class:`DecodeQuant` leaves. The Llama-family
+    generation plan dequantizes them at each matmul; embeddings, the LM
+    head, norms and biases stay full precision (the
+    quantization-error-dominant tensors, same policy as
+    ``load_and_quantize_model``). Llama-family layouts only — the other
+    plans (GPT-2/NeoX/OPT/T5/Whisper) read kernels without the dequant
+    hook, so quantizing them would crash mid-trace."""
+    params = model.params
+    try:
+        block = params["model"]["layers"]["block"]
+        block["self_attn"]["q_proj"]["kernel"]
+    except (KeyError, TypeError):
+        raise ValueError(
+            "quantize_model_for_decode supports the Llama-family stacked "
+            "(scan_layers=True) layout only; got a param tree without "
+            "model/layers/block/self_attn — use load_and_quantize_model "
+            "for generic weight-only quantized inference."
+        ) from None
+
+    def _q(tree, in_block=False):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = _q(v, in_block or k == "block")
+            elif in_block and k == "kernel" and getattr(v, "ndim", 0) >= 2:
+                out[k] = quantize_decode_kernel(v)
+            else:
+                out[k] = v
+        return out
+
+    class _DecodeQuantizedModel(type(model)):
+        def __call__(self, *args, **kwargs):
+            raise ValueError(
+                "decode-quantized models only support generate()/"
+                "speculative_generate(); run full forwards on the original "
+                "Model (its weights are untouched)."
+            )
+
+    qm = _DecodeQuantizedModel.__new__(_DecodeQuantizedModel)
+    qm.__dict__.update(model.__dict__)
+    # Detach BEFORE assigning params: on a prepared model the params setter
+    # writes through into the live accelerator train state (model.py), which
+    # must keep its full-precision weights.
+    qm._accelerator = None
+    qm.params = _q(params)
+    return qm
+
+
 def is_quantized(leaf) -> bool:
-    return isinstance(leaf, QuantizedTensor)
+    return isinstance(leaf, (QuantizedTensor, DecodeQuant))
 
 
 def quantize_params(params, config: QuantizationConfig, sep: str = "/"):

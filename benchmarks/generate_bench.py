@@ -56,6 +56,8 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--prompt-len", type=int, default=64)
     ap.add_argument("--streamed-tokens", type=int, default=4)
+    ap.add_argument("--int8", action="store_true",
+                    help="add a resident_int8 row (DecodeQuant weight-only decode)")
     args = ap.parse_args()
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -125,6 +127,30 @@ def main():
         "first_call_s": round(first_s, 2),
         "new_tokens": args.new_tokens,
     }), flush=True)
+
+    # --- Optional row: int8 weight-only resident decode --------------------
+    if args.int8:
+        from accelerate_tpu.utils.quantization import (
+            quantize_model_for_decode, quantized_nbytes,
+        )
+        from accelerate_tpu.generation import clear_generation_cache
+
+        qm = quantize_model_for_decode(res_model)
+        clear_generation_cache()
+        np.asarray(generate(qm, prompt, max_new_tokens=args.new_tokens))  # compile
+        t0 = time.perf_counter()
+        out = generate(qm, prompt, max_new_tokens=args.new_tokens)
+        np.asarray(out)
+        warm_q = time.perf_counter() - t0
+        print(json.dumps({
+            "row": "resident_int8", "s_per_token": round(warm_q / args.new_tokens, 4),
+            "tokens_per_s": round(args.new_tokens / warm_q, 1),
+            "weight_bytes": int(quantized_nbytes(qm.params)),
+            "weight_bytes_bf16": int(quantized_nbytes(res_model.params)),
+            "new_tokens": args.new_tokens,
+        }), flush=True)
+        qm = None  # free the int8 copy + its executables before the
+        clear_generation_cache()  # streamed row's per-layer buffers
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)
