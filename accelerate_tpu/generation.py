@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .models.llama import apply_rope, rms_norm, rotary_embedding
-from .utils.quantization import DecodeQuant
+from .utils.quantization import DecodeQuant, dequantize_decode_kernel
 
 
 class KVCache(NamedTuple):
@@ -84,8 +84,6 @@ def _kernel(k, dtype):
     XLA fuses convert×scale into the dot and the weight rides HBM as int8
     (the bandwidth that dominates batch-1 decode)."""
     if isinstance(k, DecodeQuant):
-        from .utils.quantization import dequantize_decode_kernel
-
         return dequantize_decode_kernel(k, dtype)
     return k.astype(dtype)
 

@@ -209,7 +209,19 @@ def plan_parameter_sharding(
         if shards_params and fsdp_axes:
             used_axes = {a for e in spec_entries if e for a in (e if isinstance(e, tuple) else (e,))}
             free_fsdp = tuple(a for a in fsdp_axes if a not in used_axes)
-            if free_fsdp and math.prod(leaf.shape) >= min_size_to_shard:
+            # Rank-1 params (norm scales, biases) stay replicated regardless
+            # of size: sharding a vector over dp_shard saves ~nothing but
+            # lets shardy propagate feature-dim sharding into every
+            # activation that touches it — the root cause of the HSDP
+            # involuntary-full-remat (see models/llama.py
+            # _pin_last_dim_replicated). Stacked scan layouts make norm
+            # scales rank-2 (L, H); the leading layer dim is sharded by pp
+            # above, never by fsdp, so exclude those too when the feature
+            # dim is all that's left.
+            rank1_like = len(leaf.shape) < 2 or (
+                scan_layer_re.search(name) and len(leaf.shape) == 2
+            )
+            if free_fsdp and not rank1_like and math.prod(leaf.shape) >= min_size_to_shard:
                 n_shards = _axis_capacity(mesh, free_fsdp)
                 best_dim, best_size = None, 0
                 for d, s in enumerate(leaf.shape):

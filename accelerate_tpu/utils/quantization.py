@@ -166,11 +166,16 @@ class DecodeQuant:
         return self.data.nbytes + self.scales.nbytes
 
 
-def quantize_decode_kernel(w: jax.Array) -> DecodeQuant:
-    """Symmetric int8 with per-(leading, last-dim) channel scales — reduce
-    only the middle (input) dims so the layer axis stays scannable."""
+def quantize_decode_kernel(w: jax.Array, input_axes: Optional[tuple] = None) -> DecodeQuant:
+    """Symmetric int8 reducing over ``input_axes`` (the contraction dims of
+    the matmul this kernel feeds), keeping a scale per every OUTPUT channel
+    — including the heads dim of 4-D attention kernels, where a single
+    outlier head must not coarsen the others' codes. Defaults to all middle
+    dims (correct for (L, in, out) MLP layouts); callers with DenseGeneral
+    layouts pass the true input dims (see ``quantize_model_for_decode``).
+    The leading layer axis is never reduced so the leaf stays scannable."""
     w32 = jnp.asarray(w, jnp.float32)
-    axes = tuple(range(1, w32.ndim - 1)) or (0,)
+    axes = input_axes if input_axes is not None else (tuple(range(1, w32.ndim - 1)) or (0,))
     amax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
     scales = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(w32 / scales), -127, 127).astype(jnp.int8)
@@ -202,13 +207,17 @@ def quantize_model_for_decode(model):
             "for generic weight-only quantized inference."
         ) from None
 
-    def _q(tree, in_block=False):
+    def _q(tree, in_block=False, parent=""):
         out = {}
         for k, v in tree.items():
             if isinstance(v, dict):
-                out[k] = _q(v, in_block or k == "block")
+                out[k] = _q(v, in_block or k == "block", parent=k)
             elif in_block and k == "kernel" and getattr(v, "ndim", 0) >= 2:
-                out[k] = quantize_decode_kernel(v)
+                # Input (contraction) dims by projection, matching the
+                # generation plan's einsums: o_proj contracts (heads, D);
+                # q/k/v and the MLP kernels contract the hidden dim only.
+                input_axes = (1, 2) if parent == "o_proj" and v.ndim == 4 else (1,)
+                out[k] = quantize_decode_kernel(v, input_axes=input_axes)
             else:
                 out[k] = v
         return out

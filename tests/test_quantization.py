@@ -206,3 +206,25 @@ def test_decode_quant_rejects_non_llama_layout():
 
     with pytest.raises(ValueError, match="Llama-family"):
         quantize_model_for_decode(Fake())
+
+
+def test_decode_quant_per_head_scales():
+    """q/k/v scales keep per-(head, channel) granularity — one outlier head
+    must not coarsen the other heads' int8 codes."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils.quantization import quantize_model_for_decode
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    model = Model.from_flax(LlamaForCausalLM(cfg), jax.random.key(0), ids)
+    qm = quantize_model_for_decode(model)
+    blk = qm.params["model"]["layers"]["block"]
+    L, H = cfg.num_hidden_layers, cfg.hidden_size
+    heads, hn = cfg.num_attention_heads, cfg.head_dim
+    assert blk["self_attn"]["q_proj"]["kernel"].scales.shape == (L, 1, heads, hn)
+    assert blk["self_attn"]["o_proj"]["kernel"].scales.shape == (L, 1, 1, H)
+    assert blk["mlp"]["gate_proj"]["kernel"].scales.shape == (L, 1, cfg.intermediate_size)
