@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .llama import _pin_last_dim_replicated
+
 
 @dataclasses.dataclass(unsafe_hash=True)
 class GPT2Config:
@@ -153,6 +155,7 @@ class GPT2LMHeadModel(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         x = GPT2Model(cfg, name="transformer")(input_ids)
+        x = _pin_last_dim_replicated(x)  # FSDP propagation guard (llama.py)
         # LM head tied to wte (GPT-2 always ties).
         embedding = self.variables["params"]["transformer"]["wte"]["embedding"]
         return (x @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)

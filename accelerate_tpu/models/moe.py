@@ -36,6 +36,7 @@ from .llama import (
     LlamaAttention,
     LlamaConfig,
     RMSNorm,
+    _pin_last_dim_replicated,
     cross_entropy_loss,
 )
 
@@ -212,6 +213,7 @@ class MixtralForCausalLM(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         x = MixtralModel(cfg, name="model")(input_ids)
+        x = _pin_last_dim_replicated(x)  # FSDP propagation guard (llama.py)
         if cfg.tie_word_embeddings:
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
             return x @ embed.T.astype(cfg.dtype)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .llama import apply_rope, rotary_embedding
+from .llama import _pin_last_dim_replicated, apply_rope, rotary_embedding
 
 
 @dataclasses.dataclass(unsafe_hash=True)
@@ -171,6 +171,7 @@ class GPTNeoXForCausalLM(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         x = GPTNeoXModel(cfg, name="gpt_neox")(input_ids)
+        x = _pin_last_dim_replicated(x)  # FSDP propagation guard (llama.py)
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
             name="embed_out",

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .llama import _pin_last_dim_replicated
+
 
 @dataclasses.dataclass(unsafe_hash=True)
 class OPTConfig:
@@ -163,6 +165,7 @@ class OPTForCausalLM(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         x = OPTModel(cfg, name="model")(input_ids)
+        x = _pin_last_dim_replicated(x)  # FSDP propagation guard (llama.py)
         embedding = self.variables["params"]["model"]["embed_tokens"]["embedding"]
         return (x @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
 

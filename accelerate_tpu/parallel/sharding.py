@@ -150,7 +150,9 @@ def plan_parameter_sharding(
     # locally (parallel/pp.py hands shard_map exactly that slice). The mesh is
     # the source of truth for the axis size — cfg may be defaulted.
     pp_size = mesh.shape.get("pp", 1)
-    scan_layer_re = re.compile(r"(^|/)layers/")
+    # Scan-container module names across the families: "layers" everywhere
+    # except GPT-2's HF-parity "h" (transformer/h/block/...).
+    scan_layer_re = re.compile(r"(^|/)(layers|h)/")
     shards_params = False
     fsdp_axes: tuple[str, ...] = ()
     if fsdp_plugin is not None and fsdp_plugin.shards_params:
