@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .llama import _pin_last_dim_replicated
+
 
 @dataclasses.dataclass(unsafe_hash=True)
 class T5Config:
@@ -169,6 +171,13 @@ class T5FFN(nn.Module):
         h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
                      name="wi")(x)
         h = nn.relu(h)
+        # NOTE: under FSDP+dp_replicate the unstacked block_0's wi kernel
+        # sharding can propagate into these activations and emit one
+        # involuntary-remat warning for that single block; pinning here was
+        # tried and made shardy's conflict WORSE (1 -> 2 warnings) — the
+        # scanned blocks (the other L-1) are clean, so this is left to the
+        # partitioner. See models/llama.py:_pin_last_dim_replicated for the
+        # boundary pins that do work.
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="wo")(h)
 
@@ -290,6 +299,7 @@ class T5ForConditionalGeneration(nn.Module):
             embed(decoder_input_ids), enc=enc, enc_mask=attention_mask
         )
         # Tied head with the 1/sqrt(d_model) scale of untied-rescale T5.
+        dec = _pin_last_dim_replicated(dec)  # FSDP propagation guard (llama.py)
         logits = (dec * (cfg.d_model ** -0.5)) @ embed.embedding.T.astype(cfg.dtype)
         return logits
 
@@ -305,6 +315,7 @@ def shift_tokens_right(labels, decoder_start_token_id: int = 0, pad_token_id: in
 
 
 def t5_cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    logits = _pin_last_dim_replicated(logits)  # FSDP propagation guard
     mask = labels != ignore_index
     safe = jnp.where(mask, labels, 0)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .llama import _pin_last_dim_replicated
+
 
 @dataclasses.dataclass(unsafe_hash=True)
 class WhisperConfig:
@@ -231,6 +233,7 @@ class WhisperForConditionalGeneration(nn.Module):
         cfg = self.config
         enc = WhisperEncoder(cfg, name="encoder")(input_features)
         dec = WhisperDecoder(cfg, name="decoder")(decoder_input_ids, enc)
+        dec = _pin_last_dim_replicated(dec)  # FSDP propagation guard (llama.py)
         embedding = self.variables["params"]["decoder"]["embed_tokens"]["embedding"]
         return (dec @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
 
