@@ -161,9 +161,20 @@ class Accelerator:
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
 
-        if deepspeed_plugin is not None and fsdp_plugin is None:
-            # ZeRO stages are sharding specs here (SURVEY.md §2.9).
-            fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
+        self._ds_gradient_clipping = None
+        if deepspeed_plugin is not None:
+            if fsdp_plugin is None:
+                # ZeRO stages are sharding specs here (SURVEY.md §2.9).
+                fsdp_plugin = deepspeed_plugin.to_fsdp_plugin()
+            # A migrated ds_config's accumulation/clipping apply like the DS
+            # engine applied them (from_ds_json) unless overridden here.
+            if (
+                gradient_accumulation_steps == 1
+                and gradient_accumulation_plugin is None
+                and deepspeed_plugin.gradient_accumulation_steps > 1
+            ):
+                gradient_accumulation_steps = deepspeed_plugin.gradient_accumulation_steps
+            self._ds_gradient_clipping = deepspeed_plugin.gradient_clipping
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() == "true":
             fsdp_plugin = FullyShardedDataParallelPlugin()
         self.fsdp_plugin = fsdp_plugin
@@ -1105,6 +1116,10 @@ class Accelerator:
         policy = self._mp_policy
         tx = self._train_states[slot].tx
         num_accum = self.gradient_state.num_steps
+        if max_grad_norm is None:
+            # Migrated ds_config gradient_clipping applies like the DS engine
+            # applied it (DeepSpeedPlugin.from_ds_json).
+            max_grad_norm = self._ds_gradient_clipping
         clip_enabled = max_grad_norm is not None
         max_norm = float(max_grad_norm or 0.0)
         meta = (

@@ -235,7 +235,11 @@ class WhisperForConditionalGeneration(nn.Module):
         dec = WhisperDecoder(cfg, name="decoder")(decoder_input_ids, enc)
         dec = _pin_last_dim_replicated(dec)  # FSDP propagation guard (llama.py)
         embedding = self.variables["params"]["decoder"]["embed_tokens"]["embedding"]
-        return (dec @ embedding.T.astype(cfg.dtype)).astype(jnp.float32)
+        # Pin the logits too: the sharded embedding would otherwise leak a
+        # vocab-dim sharding into the user's CE graph (no in-repo loss
+        # helper covers Whisper, so guard at the source).
+        logits = _pin_last_dim_replicated(dec @ embedding.T.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
 
 
 def whisper_tp_rules(scan_layers: bool = True) -> list[tuple[str, tuple]]:

@@ -354,6 +354,51 @@ class DeepSpeedPlugin(KwargsHandler):
     gradient_accumulation_steps: int = 1
     gradient_clipping: Optional[float] = None
     zero3_init_flag: bool = False
+    # Parsed from a ds_config's bf16/fp16 sections by from_ds_json — pass it
+    # to Accelerator(mixed_precision=...) yourself; the plugin only carries it.
+    mixed_precision: Optional[str] = None
+
+    @classmethod
+    def from_ds_json(cls, path: str) -> "DeepSpeedPlugin":
+        """Build from a raw DeepSpeed ``ds_config.json`` — the file the
+        reference's ``deepspeed_with_config_support`` example takes as
+        ``--deepspeed_config_file`` (fixtures: reference
+        tests/deepspeed/ds_config_zero{2,3}.json). ``"auto"`` values fall
+        back to the field defaults; engine-only keys (optimizer, scheduler,
+        comm backends) are ignored — the mesh owns those concerns."""
+        import json
+
+        with open(path) as f:
+            cfg = json.load(f)
+
+        def _noauto(v, default):
+            return default if v in (None, "auto") else v
+
+        # DeepSpeed semantics: NO zero_optimization section means ZeRO is
+        # DISABLED (stage 0); "stage": "auto" means the engine default (2).
+        z = cfg.get("zero_optimization")
+        default_stage = 2 if z is not None else 0
+        z = z or {}
+        mp = None
+        if (cfg.get("bf16", {}) or {}).get("enabled") is True:
+            mp = "bf16"
+        elif (cfg.get("fp16", {}) or {}).get("enabled") is True:
+            mp = "fp16"
+        clip = _noauto(cfg.get("gradient_clipping"), None)
+        return cls(
+            zero_stage=int(_noauto(z.get("stage"), default_stage)),
+            offload_optimizer_device=_noauto(
+                (z.get("offload_optimizer") or {}).get("device"), "none"
+            ),
+            offload_param_device=_noauto(
+                (z.get("offload_param") or {}).get("device"), "none"
+            ),
+            gradient_accumulation_steps=int(
+                _noauto(cfg.get("gradient_accumulation_steps"), 1)
+            ),
+            gradient_clipping=None if clip is None else float(clip),
+            mixed_precision=mp,
+        )
 
     def to_fsdp_plugin(self) -> FullyShardedDataParallelPlugin:
         strategy = {0: "NO_SHARD", 1: "SHARD_GRAD_OP", 2: "SHARD_GRAD_OP", 3: "FULL_SHARD"}[
