@@ -1389,6 +1389,13 @@ class Accelerator:
                 if unscale and scale is not None:
                     grads = jax.tree.map(lambda g: g / scale, grads)
                 finite = grads_all_finite(grads)
+                # The flag MUST agree across all DP workers: the reducer
+                # pmean's P/Q, so one worker's inf grads make every worker's
+                # new_comm NaN — a worker whose *local* grads were finite
+                # would otherwise commit the poisoned (replicated-declared)
+                # state and freeze the hook forever.
+                for ax in dp_axes:
+                    finite = jax.lax.pmin(finite.astype(jnp.int32), ax).astype(bool)
                 grads, new_comm = reducer(grads, comm_state)
                 # An overflowed step (inf grads -> NaN through qr) must not
                 # poison the persistent hook state: keep the previous one.

@@ -359,13 +359,20 @@ class DeepSpeedPlugin(KwargsHandler):
     mixed_precision: Optional[str] = None
 
     @classmethod
-    def from_ds_json(cls, path: str) -> "DeepSpeedPlugin":
+    def from_ds_json(
+        cls, path: str, mixed_precision: "str | None" = None
+    ) -> "DeepSpeedPlugin":
         """Build from a raw DeepSpeed ``ds_config.json`` — the file the
         reference's ``deepspeed_with_config_support`` example takes as
         ``--deepspeed_config_file`` (fixtures: reference
         tests/deepspeed/ds_config_zero{2,3}.json). ``"auto"`` values fall
         back to the field defaults; engine-only keys (optimizer, scheduler,
-        comm backends) are ignored — the mesh owns those concerns."""
+        comm backends) are ignored — the mesh owns those concerns.
+
+        ``mixed_precision`` resolves ``bf16/fp16 {"enabled": "auto"}``
+        sections, matching the reference's DeepSpeed integration where
+        "auto" inherits the accelerate-level mixed-precision setting
+        (reference: utils/deepspeed.py HfDeepSpeedConfig fill_match)."""
         import json
 
         with open(path) as f:
@@ -379,10 +386,18 @@ class DeepSpeedPlugin(KwargsHandler):
         z = cfg.get("zero_optimization")
         default_stage = 2 if z is not None else 0
         z = z or {}
+        bf16_en = (cfg.get("bf16", {}) or {}).get("enabled")
+        fp16_en = (cfg.get("fp16", {}) or {}).get("enabled")
+        # "enabled": "auto" inherits the accelerate-level setting — only for
+        # the matching section (an fp16 "auto" does not turn on bf16).
+        if bf16_en == "auto":
+            bf16_en = mixed_precision == "bf16"
+        if fp16_en == "auto":
+            fp16_en = mixed_precision == "fp16"
         mp = None
-        if (cfg.get("bf16", {}) or {}).get("enabled") is True:
+        if bf16_en is True:
             mp = "bf16"
-        elif (cfg.get("fp16", {}) or {}).get("enabled") is True:
+        elif fp16_en is True:
             mp = "fp16"
         clip = _noauto(cfg.get("gradient_clipping"), None)
         return cls(

@@ -151,10 +151,16 @@ def test_powersgd_compression_is_low_rank():
     )
 
 
-def test_powersgd_survives_overflow_step():
+@pytest.mark.parametrize("poison_pattern", ["all_workers", "one_worker"])
+def test_powersgd_survives_overflow_step(poison_pattern):
     """fp16 loss scaling x PowerSGD: an overflowing step must skip the param
     update (existing contract) AND leave the hook's error-feedback state
-    unpoisoned — training resumes normally afterwards."""
+    unpoisoned — training resumes normally afterwards.
+
+    ``one_worker`` poisons a single DP worker's shard: the reducer pmean's
+    P/Q, so one worker's inf grads NaN every worker's candidate state —
+    workers whose *local* grads stayed finite must still reject it (the
+    finite flag is pmin'd across dp axes in ``_comm_hook_step``)."""
     from accelerate_tpu import ParallelismConfig
 
     _reset()
@@ -175,28 +181,35 @@ def test_powersgd_survives_overflow_step():
         loss = cross_entropy_loss(
             module.apply({"params": params}, batch["x"]), batch["y"]
         )
-        # poison=1 -> inf loss -> inf grads (the overflow signature)
-        return jnp.where(batch["poison"].sum() > 0, jnp.inf, loss)
+        # Multiplicative poison: grads genuinely overflow through the inf
+        # factor (a constant-branch `where` would have zero gradient and
+        # never exercise the overflow path).
+        return loss * jnp.where(batch["poison"].sum() > 0, jnp.inf, 1.0)
 
     step = acc.prepare_train_step(loss_fn)
     from jax.sharding import NamedSharding, PartitionSpec
 
     bs = NamedSharding(acc.mesh, PartitionSpec(acc.parallelism_config.batch_axes))
 
-    def make_batch(poison):
+    def make_batch(poison_vec):
         return {
             "x": jax.device_put(ids[:, :-1], bs),
             "y": jax.device_put(ids[:, 1:], bs),
-            "poison": jax.device_put(
-                np.full((8,), poison, np.int32), bs
-            ),
+            "poison": jax.device_put(np.asarray(poison_vec, np.int32), bs),
         }
 
+    if poison_pattern == "all_workers":
+        poison_vec = np.ones((8,), np.int32)
+    else:
+        # One sample -> one DP worker's shard (batch 8 over dp=8).
+        poison_vec = np.zeros((8,), np.int32)
+        poison_vec[0] = 1
+
     state = acc.train_state
-    state, _ = step(state, make_batch(1))  # overflow step
+    state, _ = step(state, make_batch(poison_vec))  # overflow step
     losses = []
     for _ in range(10):
-        state, metrics = step(state, make_batch(0))
+        state, metrics = step(state, make_batch(np.zeros((8,), np.int32)))
         losses.append(float(np.asarray(metrics["loss"])))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0] - 0.3, losses

@@ -248,6 +248,33 @@ def test_deepspeed_from_ds_json_stage_semantics(tmp_path):
     assert plugin2.offload_optimizer_device == "none"
 
 
+def test_deepspeed_from_ds_json_mixed_precision_auto(tmp_path):
+    """bf16/fp16 {"enabled": "auto"} inherits the accelerate-level setting
+    (reference DeepSpeed semantics), instead of silently disabling it."""
+    import json
+
+    from accelerate_tpu.utils import DeepSpeedPlugin
+
+    p = tmp_path / "auto_mp.json"
+    p.write_text(json.dumps({"bf16": {"enabled": "auto"}}))
+    assert DeepSpeedPlugin.from_ds_json(str(p)).mixed_precision is None
+    assert (
+        DeepSpeedPlugin.from_ds_json(str(p), mixed_precision="bf16").mixed_precision
+        == "bf16"
+    )
+    # An fp16 "auto" does not turn on bf16 and vice versa.
+    assert (
+        DeepSpeedPlugin.from_ds_json(str(p), mixed_precision="fp16").mixed_precision
+        is None
+    )
+    p2 = tmp_path / "auto_fp16.json"
+    p2.write_text(json.dumps({"fp16": {"enabled": "auto"}}))
+    assert (
+        DeepSpeedPlugin.from_ds_json(str(p2), mixed_precision="fp16").mixed_precision
+        == "fp16"
+    )
+
+
 def test_deepspeed_plugin_wires_accum_and_clipping(tmp_path):
     """from_ds_json accumulation/clipping actually apply to the train step
     (they are not decorative fields)."""
