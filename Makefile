@@ -5,7 +5,7 @@ ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: test test_smoke test_core test_models test_parallel test_big_modeling \
-        test_cli test_examples test_checkpointing test_hub quality bench
+        test_cli test_examples test_checkpointing test_hub test_tpu quality bench
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -58,6 +58,12 @@ test_examples:
 
 test_hub:
 	$(PYTEST) tests/test_hub.py
+
+# TPU kernel tier: compiled-mode Pallas/fp8/int8/train-step health on the
+# real chip (~2-3 min). Serial on purpose — only one process may hold the
+# chip tunnel. Skips cleanly (with the reason) when no chip is reachable.
+test_tpu:
+	ACCELERATE_TEST_USE_TPU=1 python -m pytest -q -rs tests/tpu/
 
 bench:
 	python bench.py

@@ -1,0 +1,62 @@
+"""TPU-gated kernel tier — runs ONLY against a real chip.
+
+CI runs the whole suite on the virtual CPU mesh, which exercises the Pallas
+kernels in *interpreter* mode only (`ops/pallas_flash.py:default_interpret`).
+A compiled-lowering regression (Mosaic tiling, SMEM prefetch, scalar-prefetch
+offsets) is invisible to that suite. This tier is the compiled-mode health
+check, kept separable from the full `bench.py` ladder so kernel status costs
+~2 min of chip time, not 16.
+
+Run via `make test_tpu` (sets ACCELERATE_TEST_USE_TPU=1, serial). Everything
+here skips cleanly when no chip is reachable: the axon relay dying makes
+`jax.devices()` HANG rather than error, so the availability probe runs in a
+subprocess with a hard timeout. Only one TPU process can use the tunnel at a
+time — never run this tier concurrently with bench.py.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_TIER_DIR = Path(__file__).parent
+
+_PROBE = (
+    "import jax; d = jax.devices(); "
+    "import sys; sys.exit(0 if d and d[0].platform in ('tpu', 'axon') else 1)"
+)
+
+
+def _tpu_reason():
+    if not os.environ.get("ACCELERATE_TEST_USE_TPU"):
+        return "TPU tier needs ACCELERATE_TEST_USE_TPU=1 (use `make test_tpu`)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            timeout=int(os.environ.get("ACCELERATE_TPU_PROBE_TIMEOUT_S", "90")),
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return "TPU relay unreachable (probe hung — axon relay down)"
+    if r.returncode != 0:
+        return f"no TPU device (probe rc={r.returncode})"
+    return None
+
+
+def pytest_configure(config):
+    config._tpu_skip_reason = _tpu_reason()
+
+
+def pytest_collection_modifyitems(config, items):
+    reason = getattr(config, "_tpu_skip_reason", None)
+    if reason is None:
+        return
+    marker = pytest.mark.skip(reason=reason)
+    # This hook receives EVERY collected item in the session, not just this
+    # directory's — mark only the TPU tier or `pytest tests/` would skip the
+    # whole CPU suite.
+    for item in items:
+        if _TIER_DIR in Path(str(item.fspath)).parents:
+            item.add_marker(marker)
