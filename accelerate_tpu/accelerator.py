@@ -319,6 +319,7 @@ class Accelerator:
         return fp8_dot_general(
             recipe.fp8_format if recipe else "HYBRID",
             use_during_eval=recipe.use_during_eval if recipe else False,
+            native=recipe.native_dots if recipe else None,
         )
 
     @property

@@ -41,6 +41,7 @@ class BertConfig:
     remat: bool = False
     fp8: bool = False
     fp8_format: str = "HYBRID"
+    fp8_backend: str = "AUTO"      # AUTO | TE | AO | QDQ (ops/fp8.py backend_to_native)
 
     @property
     def head_dim(self) -> int:
@@ -52,7 +53,9 @@ class BertConfig:
             return None
         from ..ops.fp8 import fp8_dot_general
 
-        return fp8_dot_general(self.fp8_format)
+        from ..ops.fp8 import backend_to_native
+
+        return fp8_dot_general(self.fp8_format, native=backend_to_native(self.fp8_backend))
 
     @classmethod
     def tiny(cls, **kw):

@@ -1,0 +1,470 @@
+"""Generic HF-checkpoint ingestion: declarative name-mapping rules.
+
+The reference's product is "wrap *your* model" — any torch module loads via
+``load_checkpoint_in_model`` (reference: utils/modeling.py:1805-2065) because
+the weights land in the user's own module by name. A flax-native framework
+can't do that literally, but most decoder-only transformer checkpoints are
+the same chassis with different tensor *names* and a few architectural
+constants. This module closes the gap: an :class:`ArchSpec` maps an unseen
+``model_type`` onto a native family (usually the Llama chassis, whose config
+knobs cover norms/MLP shape/rotary fraction/biases) with
+
+- ``config_map`` — HF config keys → native config fields, plus constants, and
+- ``WeightRule`` s — regex over checkpoint names → native tree paths, with
+  the five layout ops every mapping in hub.py is built from (copy, linear
+  transpose, per-head attention reshapes, fused-QKV split).
+
+So a new Llama-era architecture (StarCoder2, StableLM, InternLM2, ...) loads
+by *data*, not by a new ~100-line mapping function. ``hub.load_pretrained``
+falls back here whenever ``model_type`` isn't in the hand-written family
+table; users register their own specs with :func:`register_arch_spec`.
+
+Logit parity for the built-in specs is tested against the transformers
+implementations in tests/test_generic_hub.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Rule primitives
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WeightRule:
+    """One checkpoint-name pattern → one (or, for splits, several) tree paths.
+
+    src: regex matched against the full HF tensor name. Use ``(?P<i>\\d+)``
+         for the layer index — matching tensors are stacked into the
+         ``nn.scan`` layer-major layout automatically.
+    dst: native tree path template ("/"-separated, ``{i}`` NOT included: the
+         engine owns layer placement). For ``op="qkv_split"`` this is the
+         ``self_attn`` prefix; the rule emits q_proj/k_proj/v_proj under it.
+    op:  copy        — as-is (embeddings, norm weights/biases)
+         linear      — torch (out, in) → flax (in, out) transpose
+         attn_in     — transpose + reshape (hidden, heads, head_dim);
+                       set ``heads`` = "q" or "kv"
+         attn_in_bias— reshape (heads*head_dim,) → (heads, head_dim)
+         attn_out    — transpose + reshape (heads, head_dim, hidden)
+         qkv_split   — fused QKV (InternLM2/NeoX-style grouped layout):
+                       split into q/k/v, then attn_in each part
+    """
+
+    src: str
+    dst: str
+    op: str = "copy"
+    heads: Optional[str] = None
+    # Skip when the target config ties embeddings (torch state dicts list the
+    # tied lm_head.weight alias; the native tied module has no lm_head).
+    unless_tied: bool = False
+
+    def __post_init__(self):
+        ops = ("copy", "linear", "attn_in", "attn_in_bias", "attn_out", "qkv_split")
+        if self.op not in ops:
+            raise ValueError(f"WeightRule.op must be one of {ops}, got {self.op!r}")
+        if self.op in ("attn_in", "attn_in_bias") and self.heads not in ("q", "kv"):
+            raise ValueError(f"op={self.op!r} needs heads='q'|'kv'")
+
+
+@dataclasses.dataclass(frozen=True)
+class ArchSpec:
+    """Declarative recipe: HF checkpoint of ``model_type`` → native family.
+
+    target:     native family key, must exist in hub._FAMILIES (its module
+                class is reused; "llama" covers the decoder chassis).
+    config_map: native-config field → HF config key (str), a chain of keys
+                with a default (``("key1", "key2", default)`` — first present
+                wins; a non-str final element is the default), or a constant
+                via ``Const(value)``.
+    rules:      weight rules. Every checkpoint tensor must be claimed by
+                exactly one rule and every native param produced — unmapped /
+                missing names raise with both lists (fail loud, not NaN).
+    require:    HF-config invariants the target chassis assumes, as
+                {hf_key: allowed value or tuple of values}. Violations raise
+                at load time — a shape-compatible tree with silently wrong
+                *compute* (e.g. parallel residual) must never load.
+    """
+
+    target: str
+    config_map: dict
+    rules: tuple
+    require: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "rules", tuple(self.rules))
+
+
+@dataclasses.dataclass(frozen=True)
+class Const:
+    value: Any
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _cfg_get(hf_cfg, key, default=None):
+    if isinstance(hf_cfg, dict):
+        return hf_cfg.get(key, default)
+    return getattr(hf_cfg, key, default)
+
+
+def build_config(spec: ArchSpec, hf_cfg) -> Any:
+    """Resolve spec.config_map against the HF config → native config."""
+    from . import hub
+
+    kwargs = {}
+    for field, source in spec.config_map.items():
+        if isinstance(source, Const):
+            kwargs[field] = source.value
+        elif isinstance(source, str):
+            kwargs[field] = _cfg_get(hf_cfg, source)
+        elif isinstance(source, (tuple, list)):
+            *keys, default = source
+            val = None
+            for k in keys:
+                val = _cfg_get(hf_cfg, k)
+                if val is not None:
+                    break
+            kwargs[field] = val if val is not None else default
+        else:
+            raise TypeError(f"config_map[{field!r}]: bad source {source!r}")
+    cfg_cls = _target_config_cls(spec.target)
+    return cfg_cls(**{k: v for k, v in kwargs.items() if v is not None})
+
+
+def _target_config_cls(target: str):
+    if target == "llama":
+        from .llama import LlamaConfig
+
+        return LlamaConfig
+    raise ValueError(f"ArchSpec.target {target!r} not supported (known: llama)")
+
+
+# ---------------------------------------------------------------------------
+# Weight-rule application
+# ---------------------------------------------------------------------------
+
+def _apply_op(rule: WeightRule, arr: np.ndarray, cfg) -> dict[str, np.ndarray]:
+    """Returns {relative_dst_path: tensor} (several entries for qkv_split)."""
+    h, nh, nkv, d = (
+        cfg.hidden_size, cfg.num_attention_heads, cfg.num_key_value_heads,
+        cfg.head_dim,
+    )
+    if rule.op == "copy":
+        return {rule.dst: arr}
+    if rule.op == "linear":
+        return {rule.dst: arr.T}
+    if rule.op == "attn_in":
+        n = nh if rule.heads == "q" else nkv
+        return {rule.dst: arr.T.reshape(h, n, d)}
+    if rule.op == "attn_in_bias":
+        n = nh if rule.heads == "q" else nkv
+        return {rule.dst: arr.reshape(n, d)}
+    if rule.op == "attn_out":
+        return {rule.dst: arr.T.reshape(nh, d, h)}
+    if rule.op == "qkv_split":
+        # Grouped layout (InternLM2 wqkv / NeoX query_key_value): per KV
+        # group, [ratio q heads | 1 k head | 1 v head] along the out dim.
+        ratio = nh // nkv
+        w = arr.reshape(nkv, (ratio + 2) * d, h)  # (groups, group_rows, in)
+        q = w[:, : ratio * d].reshape(nkv * ratio * d, h)
+        k = w[:, ratio * d: (ratio + 1) * d].reshape(nkv * d, h)
+        v = w[:, (ratio + 1) * d:].reshape(nkv * d, h)
+        return {
+            f"{rule.dst}/q_proj/kernel": q.T.reshape(h, nh, d),
+            f"{rule.dst}/k_proj/kernel": k.T.reshape(h, nkv, d),
+            f"{rule.dst}/v_proj/kernel": v.T.reshape(h, nkv, d),
+        }
+    raise AssertionError(rule.op)
+
+
+def build_params(spec: ArchSpec, cfg, sd: dict) -> dict:
+    """Apply spec.rules to a state dict → native param tree (scan layout
+    honored via cfg.scan_layers, same placement as every hub.py family)."""
+    from .hub import _place_layers, _set, _stack_layers
+
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    active = [r for r in spec.rules if not (r.unless_tied and tied)]
+    skipped = [re.compile(r.src) for r in spec.rules if r.unless_tied and tied]
+    compiled = [(re.compile(r.src), r) for r in active]
+    tree: dict = {}
+    per_layer: list[dict] = [dict() for _ in range(cfg.num_hidden_layers)]
+    unmatched: list[str] = []
+    for name, tensor in sd.items():
+        hits = [(m, r) for pat, r in compiled for m in [pat.fullmatch(name)] if m]
+        if not hits:
+            if not any(pat.fullmatch(name) for pat in skipped):
+                unmatched.append(name)
+            continue
+        if len(hits) > 1:
+            owners = ", ".join(r.src for _, r in hits)
+            raise ValueError(f"{name!r} claimed by multiple rules: {owners}")
+        m, rule = hits[0]
+        placed = _apply_op(rule, _np(tensor), cfg)
+        layer = m.groupdict().get("i")
+        if layer is not None:
+            if int(layer) >= cfg.num_hidden_layers:
+                raise ValueError(
+                    f"{name!r} addresses layer {layer} but the resolved "
+                    f"config has num_hidden_layers={cfg.num_hidden_layers} — "
+                    f"check the spec's config_map."
+                )
+            per_layer[int(layer)].update(placed)
+        else:
+            for path, arr in placed.items():
+                _set(tree, path, arr)
+    if unmatched:
+        raise ValueError(
+            f"{len(unmatched)} checkpoint tensors matched no rule for "
+            f"model_type spec (first few: {sorted(unmatched)[:8]}). Add rules "
+            f"or pass family= explicitly."
+        )
+    if any(per_layer):
+        missing = [i for i, l in enumerate(per_layer) if not l]
+        if missing:
+            raise ValueError(f"No per-layer tensors found for layers {missing}")
+        _place_layers(
+            tree, _stack_layers(per_layer), cfg.scan_layers,
+            "model/layers/block", "model/layers_{i}", cfg.num_hidden_layers,
+        )
+    return tree
+
+
+def validate_against_module(cfg, params, module_cls) -> None:
+    """Shape-check the produced tree against the module's init shapes. Raises
+    listing missing / unexpected / mis-shaped paths — the actionable version
+    of the reference's load_checkpoint_in_model unexpected/missing keys."""
+    import jax
+
+    module = module_cls(cfg)
+    ref_shapes = jax.eval_shape(
+        lambda: module.init(
+            jax.random.key(0), np.zeros((1, 8), np.int32)
+        )["params"]
+    )
+
+    def flatten(tree, prefix=""):
+        out = {}
+        for k, v in tree.items():
+            path = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                out.update(flatten(v, path))
+            else:
+                out[path] = tuple(v.shape)
+        return out
+
+    got = flatten(params)
+    want = flatten(ref_shapes)
+    problems = []
+    for path in sorted(set(want) - set(got)):
+        problems.append(f"missing {path} {want[path]}")
+    for path in sorted(set(got) - set(want)):
+        problems.append(f"unexpected {path} {got[path]}")
+    for path in sorted(set(got) & set(want)):
+        if got[path] != want[path]:
+            problems.append(f"shape {path}: checkpoint {got[path]} vs module {want[path]}")
+    if problems:
+        raise ValueError(
+            "Generic ingestion produced a tree the module can't load:\n  "
+            + "\n  ".join(problems)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Spec registry + built-in specs
+# ---------------------------------------------------------------------------
+
+_SPECS: dict[str, ArchSpec] = {}
+
+
+def register_arch_spec(model_type: str, spec: ArchSpec) -> None:
+    """Register (or override) the ingestion recipe for a ``model_type``.
+    This is the public extension point: new architectures become loadable
+    without touching framework code."""
+    _SPECS[model_type] = spec
+
+
+def get_arch_spec(model_type: str) -> Optional[ArchSpec]:
+    return _SPECS.get(model_type)
+
+
+def known_generic_types() -> list[str]:
+    return sorted(_SPECS)
+
+
+def load_with_spec(spec: ArchSpec, hf_cfg, sd: dict, dtype) -> tuple:
+    """(config, params, module_class) — the generic analog of the per-family
+    branch in hub.load_pretrained."""
+    import dataclasses as _dc
+
+    import importlib
+
+    for key, allowed in spec.require.items():
+        allowed = allowed if isinstance(allowed, tuple) else (allowed,)
+        got = _cfg_get(hf_cfg, key, allowed[0])
+        if got not in allowed:
+            raise ValueError(
+                f"Checkpoint config {key}={got!r} is outside what the "
+                f"{spec.target!r} chassis computes (allowed: {allowed}); "
+                f"loading would be shape-compatible but semantically wrong."
+            )
+    cfg = _dc.replace(build_config(spec, hf_cfg), dtype=dtype)
+    params = build_params(spec, cfg, sd)
+    from . import hub
+
+    cls_name = hub._FAMILIES[spec.target][0]
+    models_pkg = importlib.import_module(__package__)
+    module_cls = getattr(models_pkg, cls_name)
+    validate_against_module(cfg, params, module_cls)
+    return cfg, params, module_cls
+
+
+# Shared sub-rule sets -------------------------------------------------------
+
+_LLAMA_STYLE_CONFIG = {
+    "vocab_size": "vocab_size",
+    "hidden_size": "hidden_size",
+    "intermediate_size": "intermediate_size",
+    "num_hidden_layers": "num_hidden_layers",
+    "num_attention_heads": "num_attention_heads",
+    "num_key_value_heads": ("num_key_value_heads", "num_attention_heads", None),
+    "max_position_embeddings": ("max_position_embeddings", 4096),
+    "rope_theta": ("rope_theta", 10000.0),
+    "tie_word_embeddings": ("tie_word_embeddings", False),
+    "hidden_act": ("hidden_act", "silu"),
+}
+
+_L = r"model\.layers\.(?P<i>\d+)\."
+
+
+def _llama_name_rules(*, gated=True, norm_bias=False, qkv_bias=False,
+                      out_bias=False, mlp_bias=False,
+                      up_name="up_proj", gate_name="gate_proj",
+                      down_name="down_proj"):
+    """Rules for checkpoints using Llama-style tensor names (the dominant
+    convention: StarCoder2, StableLM, Qwen-likes all use it)."""
+    rules = [
+        WeightRule(r"model\.embed_tokens\.weight", "model/embed_tokens/embedding"),
+        WeightRule(r"model\.norm\.weight", "model/norm/weight"),
+        WeightRule(r"lm_head\.weight", "lm_head/kernel", op="linear",
+                   unless_tied=True),
+        WeightRule(_L + r"self_attn\.q_proj\.weight", "self_attn/q_proj/kernel",
+                   op="attn_in", heads="q"),
+        WeightRule(_L + r"self_attn\.k_proj\.weight", "self_attn/k_proj/kernel",
+                   op="attn_in", heads="kv"),
+        WeightRule(_L + r"self_attn\.v_proj\.weight", "self_attn/v_proj/kernel",
+                   op="attn_in", heads="kv"),
+        WeightRule(_L + r"self_attn\.o_proj\.weight", "self_attn/o_proj/kernel",
+                   op="attn_out"),
+        WeightRule(_L + r"input_layernorm\.weight", "input_layernorm/weight"),
+        WeightRule(_L + r"post_attention_layernorm\.weight",
+                   "post_attention_layernorm/weight"),
+        WeightRule(_L + rf"mlp\.{up_name}\.weight", "mlp/up_proj/kernel", op="linear"),
+        WeightRule(_L + rf"mlp\.{down_name}\.weight", "mlp/down_proj/kernel", op="linear"),
+    ]
+    if gated:
+        rules.append(WeightRule(_L + rf"mlp\.{gate_name}\.weight",
+                                "mlp/gate_proj/kernel", op="linear"))
+    if norm_bias:
+        rules += [
+            WeightRule(r"model\.norm\.bias", "model/norm/bias"),
+            WeightRule(_L + r"input_layernorm\.bias", "input_layernorm/bias"),
+            WeightRule(_L + r"post_attention_layernorm\.bias",
+                       "post_attention_layernorm/bias"),
+        ]
+    if qkv_bias:
+        rules += [
+            WeightRule(_L + r"self_attn\.q_proj\.bias", "self_attn/q_proj/bias",
+                       op="attn_in_bias", heads="q"),
+            WeightRule(_L + r"self_attn\.k_proj\.bias", "self_attn/k_proj/bias",
+                       op="attn_in_bias", heads="kv"),
+            WeightRule(_L + r"self_attn\.v_proj\.bias", "self_attn/v_proj/bias",
+                       op="attn_in_bias", heads="kv"),
+        ]
+    if out_bias:
+        rules.append(WeightRule(_L + r"self_attn\.o_proj\.bias",
+                                "self_attn/o_proj/bias"))
+    if mlp_bias:
+        rules += [
+            WeightRule(_L + rf"mlp\.{up_name}\.bias", "mlp/up_proj/bias"),
+            WeightRule(_L + rf"mlp\.{down_name}\.bias", "mlp/down_proj/bias"),
+        ]
+        if gated:
+            rules.append(WeightRule(_L + rf"mlp\.{gate_name}\.bias",
+                                    "mlp/gate_proj/bias"))
+    return rules
+
+
+# StarCoder2 (transformers models/starcoder2): Llama names, but LayerNorm
+# (with bias), plain gelu MLP named c_fc/c_proj, biases everywhere.
+register_arch_spec("starcoder2", ArchSpec(
+    target="llama",
+    config_map={
+        **_LLAMA_STYLE_CONFIG,
+        "norm_type": Const("layernorm"),
+        "rms_norm_eps": ("norm_epsilon", 1e-5),
+        "mlp_gated": Const(False),
+        "mlp_bias": ("use_bias", True),
+        "attention_bias": ("use_bias", True),
+        "attention_out_bias": ("use_bias", True),
+        "tie_word_embeddings": ("tie_word_embeddings", True),
+        "hidden_act": ("hidden_act", "gelu_pytorch_tanh"),
+    },
+    rules=_llama_name_rules(
+        gated=False, norm_bias=True, qkv_bias=True, out_bias=True,
+        mlp_bias=True, up_name="c_fc", down_name="c_proj",
+    ),
+    # The chassis computes full causal attention; a checkpoint trained with
+    # a sliding window diverges for sequences longer than the window, so
+    # refuse rather than load shape-compatibly-but-wrong. Users who know
+    # their sequences stay within the window can re-register this spec
+    # without the guard (register_arch_spec overrides).
+    require={"sliding_window": None},
+))
+
+# StableLM (transformers models/stablelm): LayerNorm with bias, gated silu
+# MLP, partial rotary, optional qkv bias (off by default).
+register_arch_spec("stablelm", ArchSpec(
+    target="llama",
+    config_map={
+        **_LLAMA_STYLE_CONFIG,
+        "norm_type": Const("layernorm"),
+        "rms_norm_eps": ("layer_norm_eps", 1e-5),
+        "partial_rotary_factor": ("partial_rotary_factor", 0.25),
+        "attention_bias": ("use_qkv_bias", False),
+    },
+    rules=_llama_name_rules(norm_bias=True),
+    require={"use_parallel_residual": False, "qk_layernorm": False},
+))
+
+# InternLM2: exactly the Llama chassis with renamed tensors and a fused,
+# KV-grouped wqkv — the fused-split showcase.
+register_arch_spec("internlm2", ArchSpec(
+    target="llama",
+    config_map={
+        **_LLAMA_STYLE_CONFIG,
+        "attention_bias": ("bias", False),
+    },
+    rules=[
+        WeightRule(r"model\.tok_embeddings\.weight", "model/embed_tokens/embedding"),
+        WeightRule(r"model\.norm\.weight", "model/norm/weight"),
+        WeightRule(r"output\.weight", "lm_head/kernel", op="linear"),
+        WeightRule(_L + r"attention\.wqkv\.weight", "self_attn", op="qkv_split"),
+        WeightRule(_L + r"attention\.wo\.weight", "self_attn/o_proj/kernel",
+                   op="attn_out"),
+        WeightRule(_L + r"feed_forward\.w1\.weight", "mlp/gate_proj/kernel", op="linear"),
+        WeightRule(_L + r"feed_forward\.w3\.weight", "mlp/up_proj/kernel", op="linear"),
+        WeightRule(_L + r"feed_forward\.w2\.weight", "mlp/down_proj/kernel", op="linear"),
+        WeightRule(_L + r"attention_norm\.weight", "input_layernorm/weight"),
+        WeightRule(_L + r"ffn_norm\.weight", "post_attention_layernorm/weight"),
+    ],
+))

@@ -959,8 +959,20 @@ def load_pretrained(src, family: Optional[str] = None, dtype=jnp.bfloat16):
         family = (hf_cfg.get("model_type") if isinstance(hf_cfg, dict)
                   else getattr(hf_cfg, "model_type", None))
     if family not in _FAMILIES:
+        # Declarative fallback: unseen architectures load via registered
+        # ArchSpec rules (models/generic_hub.py) — data, not new code.
+        from . import generic_hub
+
+        spec = generic_hub.get_arch_spec(family)
+        if spec is not None:
+            return generic_hub.load_with_spec(spec, hf_cfg, sd, dtype)
         known = ", ".join(sorted(_FAMILIES))
-        raise ValueError(f"Unsupported model family {family!r}; supported: {known}")
+        generic = ", ".join(generic_hub.known_generic_types())
+        raise ValueError(
+            f"Unsupported model family {family!r}; hand-written families: "
+            f"{known}; generic specs: {generic}. Register new architectures "
+            f"with accelerate_tpu.models.generic_hub.register_arch_spec."
+        )
     cls_name, cfg_fn, params_fn = _FAMILIES[family]
     import dataclasses as _dc
 

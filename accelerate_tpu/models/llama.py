@@ -47,6 +47,16 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     attention_bias: bool = False        # Qwen2-style checkpoints: bias on q/k/v
+    # Decoder-variant knobs (all default off → plain Llama). These make the
+    # family a configurable decoder chassis: most Llama-era architectures
+    # (StarCoder2, StableLM, InternLM2, Granite, ...) are this block with
+    # different constants, which is what lets models/generic_hub.py ingest
+    # unseen checkpoints with declarative rules instead of new module code.
+    norm_type: str = "rmsnorm"          # "layernorm": mean-centered, with bias
+    mlp_gated: bool = True              # False: up_proj -> act -> down_proj
+    mlp_bias: bool = False              # biases on the MLP projections
+    attention_out_bias: bool = False    # bias on o_proj
+    partial_rotary_factor: float = 1.0  # rotate only this fraction of head_dim
     # Gemma-family quirks (all default off → plain Llama):
     hidden_act: str = "silu"            # "gelu_tanh" for Gemma's GeGLU
     rms_norm_plus_one: bool = False     # norm scale stored as (weight + 1)
@@ -63,23 +73,37 @@ class LlamaConfig:
     # flash = Pallas fused kernel on TPU (blockwise scan fallback off-TPU);
     # native = materialized O(S²) softmax, kept for parity tests.
     attention_impl: str = "flash"       # flash | native | ring | ulysses
-    fp8: bool = False                   # fp8 (QDQ) matmuls in MLP/attention projections
+    fp8: bool = False                   # fp8 matmuls in MLP/attention projections
     fp8_format: str = "HYBRID"          # E4M3 | E5M2 | HYBRID (e4m3 fwd / e5m2 bwd)
+    fp8_backend: str = "AUTO"           # AUTO | TE | AO | QDQ (ops/fp8.py backend_to_native)
 
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.norm_type not in ("rmsnorm", "layernorm"):
+            raise ValueError(f"norm_type must be rmsnorm|layernorm, got {self.norm_type}")
+        if self.rotary_dim % 2:
+            raise ValueError(
+                f"partial_rotary_factor {self.partial_rotary_factor} of head_dim "
+                f"{self.head_dim} gives odd rotary_dim {self.rotary_dim}"
+            )
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.partial_rotary_factor)
 
     @property
     def dot_general(self):
-        """dot_general injected into every projection: fp8 QDQ when enabled
+        """dot_general injected into every projection: fp8 when enabled
         (ops/fp8.py — the reference's TE/AO fp8 linear swap role), else the
         XLA default."""
         if not self.fp8:
             return None
-        from ..ops.fp8 import fp8_dot_general
+        from ..ops.fp8 import backend_to_native, fp8_dot_general
 
-        return fp8_dot_general(self.fp8_format)
+        return fp8_dot_general(
+            self.fp8_format, native=backend_to_native(self.fp8_backend)
+        )
 
     @classmethod
     def tiny(cls, **kw):
@@ -119,6 +143,44 @@ class RMSNorm(nn.Module):
         if self.plus_one:
             weight = weight + 1.0
         return rms_norm(x, weight.astype(x.dtype), self.eps)
+
+
+class LayerNorm(nn.Module):
+    """Mean-centered norm with bias, params named weight/bias to match the
+    torch checkpoint convention the hub mappings use (flax's nn.LayerNorm
+    calls them scale/bias)."""
+
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        return (y * weight + bias).astype(x.dtype)
+
+
+def make_norm(cfg: "LlamaConfig", name: str):
+    if cfg.norm_type == "layernorm":
+        return LayerNorm(cfg.rms_norm_eps, name=name)
+    return RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name=name)
+
+
+def activation_fn(name: str):
+    table = {
+        "silu": nn.silu,
+        "gelu": partial(nn.gelu, approximate=False),
+        "gelu_tanh": partial(nn.gelu, approximate=True),
+        "gelu_new": partial(nn.gelu, approximate=True),
+        "gelu_pytorch_tanh": partial(nn.gelu, approximate=True),
+        "relu": nn.relu,
+    }
+    if name not in table:
+        raise ValueError(f"Unknown hidden_act {name!r}; known: {sorted(table)}")
+    return table[name]
 
 
 def rotary_embedding(positions: jax.Array, head_dim: int, theta: float, dtype) -> tuple[jax.Array, jax.Array]:
@@ -193,14 +255,19 @@ class LlamaAttention(nn.Module):
         q = dense(features=(cfg.num_attention_heads, d), name="q_proj")(x)
         k = dense(features=(cfg.num_key_value_heads, d), name="k_proj")(x)
         v = dense(features=(cfg.num_key_value_heads, d), name="v_proj")(x)
-        cos, sin = rotary_embedding(positions, d, cfg.rope_theta, x.dtype)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        rd = cfg.rotary_dim
+        cos, sin = rotary_embedding(positions, rd, cfg.rope_theta, x.dtype)
+        if rd == d:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        else:  # partial rotary (StableLM/NeoX-style): rotate the first rd dims
+            q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin), q[..., rd:]], -1)
+            k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin), k[..., rd:]], -1)
         attn_fn = _dispatch_attention(cfg.attention_impl)
         out = attn_fn(q, k, v, causal=True)
         return nn.DenseGeneral(
-            features=x.shape[-1], axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name="o_proj",
+            features=x.shape[-1], axis=(-2, -1), use_bias=cfg.attention_out_bias,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj",
             **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
         )(out)
 
@@ -212,13 +279,17 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = partial(
-            nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            nn.Dense, use_bias=cfg.mlp_bias, dtype=cfg.dtype, param_dtype=jnp.float32,
             **({"dot_general": cfg.dot_general} if cfg.fp8 else {}),
         )
-        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        act = activation_fn(cfg.hidden_act)
         up = dense(cfg.intermediate_size, name="up_proj")(x)
-        act = nn.silu if cfg.hidden_act == "silu" else partial(nn.gelu, approximate=True)
-        return dense(cfg.hidden_size, name="down_proj")(act(gate) * up)
+        if cfg.mlp_gated:
+            gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+            hidden = act(gate) * up
+        else:  # plain 2-layer MLP (GPT/StarCoder2-style)
+            hidden = act(up)
+        return dense(cfg.hidden_size, name="down_proj")(hidden)
 
 
 class LlamaBlock(nn.Module):
@@ -228,11 +299,10 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="input_layernorm")(x),
-            positions,
+            make_norm(cfg, "input_layernorm")(x), positions
         )
         out = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="post_attention_layernorm")(h)
+            make_norm(cfg, "post_attention_layernorm")(h)
         )
         return out
 
@@ -300,7 +370,7 @@ class LlamaModel(nn.Module):
                 if cfg.remat:
                     blk = nn.remat(blk, **remat_kwargs)
                 x = blk(cfg, name=f"layers_{i}")(x, positions)
-        return RMSNorm(cfg.rms_norm_eps, cfg.rms_norm_plus_one, name="norm")(x)
+        return make_norm(cfg, "norm")(x)
 
 
 class LlamaForCausalLM(nn.Module):

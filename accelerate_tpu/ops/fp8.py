@@ -51,8 +51,8 @@ def in_eval_mode() -> bool:
     return getattr(_EVAL_MODE, "active", False)
 
 
-def _qdq(x: jax.Array, fp8_dtype, fp8_max: float) -> jax.Array:
-    """Quantize to fp8 with a per-tensor dynamic scale, dequantize back.
+def _quant(x: jax.Array, fp8_dtype, fp8_max: float):
+    """x → (f8 tensor, fp32 scale) with per-tensor dynamic scaling.
 
     The scale maps the tensor's amax onto the fp8 dtype's max, so the full
     dynamic range of the format is used every call (torchao "dynamic scaling";
@@ -62,7 +62,12 @@ def _qdq(x: jax.Array, fp8_dtype, fp8_max: float) -> jax.Array:
     """
     amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
     scale = jnp.where(amax > 0, amax / fp8_max, 1.0)
-    q = (x.astype(jnp.float32) / scale).astype(fp8_dtype)
+    return (x.astype(jnp.float32) / scale).astype(fp8_dtype), scale
+
+
+def _qdq(x: jax.Array, fp8_dtype, fp8_max: float) -> jax.Array:
+    """Quantize-dequantize: the simulation formulation of :func:`_quant`."""
+    q, scale = _quant(x, fp8_dtype, fp8_max)
     return (q.astype(jnp.float32) * scale).astype(x.dtype)
 
 
@@ -92,14 +97,134 @@ def _qdq_hybrid_bwd(_, g):
 qdq_hybrid.defvjp(_qdq_hybrid_fwd, _qdq_hybrid_bwd)
 
 
-def fp8_dot_general(fp8_format: str = "HYBRID", use_during_eval: bool = False):
-    """Returns a drop-in ``lax.dot_general`` replacement quantizing both
-    operands to fp8. Plug into ``nn.Dense(dot_general=...)``.
+# ---------------------------------------------------------------------------
+# Native float8 dot path
+# ---------------------------------------------------------------------------
+
+def backend_to_native(backend: str) -> Optional[bool]:
+    """Reference-parity backend aliases (accelerator.py:478-503) → the
+    ``native`` flag of :func:`fp8_dot_general`. TE and torchao both map to
+    native float8-operand dots (their recipes are the same computation under
+    XLA); QDQ forces the simulation; AUTO (None) defers to the platform
+    default (env ``ACCELERATE_FP8_NATIVE``). MS-AMP is deprecated upstream
+    and deliberately dropped (COVERAGE.md)."""
+    b = backend.upper()
+    if b == "MSAMP":
+        raise ValueError(
+            "MS-AMP is deprecated upstream and not supported; use "
+            '"AUTO" (or "TE"/"AO" — both select native float8 dots).'
+        )
+    table = {"AUTO": None, "TE": True, "AO": True, "QDQ": False}
+    if b not in table:
+        raise ValueError(f"fp8 backend must be AUTO|TE|AO|QDQ, got {backend!r}")
+    return table[b]
+
+
+def _fmt_dtypes(fmt: str):
+    if fmt == "HYBRID":
+        return jnp.float8_e4m3fn, jnp.float8_e5m2
+    if fmt == "E4M3":
+        return jnp.float8_e4m3fn, jnp.float8_e4m3fn
+    if fmt == "E5M2":
+        return jnp.float8_e5m2, jnp.float8_e5m2
+    raise ValueError(f"fp8_format must be E4M3|E5M2|HYBRID, got {fmt}")
+
+
+_F8_MAX = {jnp.float8_e4m3fn: E4M3_MAX, jnp.float8_e5m2: E5M2_MAX}
+
+
+def _grad_dns(dimension_numbers, lhs_ndim: int, rhs_ndim: int):
+    """Transposed dimension numbers + output permutations for the two
+    cotangent dots of a batch-free dot_general.
+
+    out = dot(lhs, rhs) has dims [lhs_free..., rhs_free...]:
+      dlhs = dot(g, rhs)  contracting g's rhs_free block with rhs's free dims
+      drhs = dot(lhs, g)  contracting lhs's free dims with g's lhs_free block
+    dot_general emits the remaining dims of each operand in ascending order,
+    so each result needs a permutation back to the operand's native layout
+    (the contracted-dim pairing lc[j] ↔ rc[j] is order-significant).
+    """
+    (lc, rc), _ = dimension_numbers
+    lhs_free = [i for i in range(lhs_ndim) if i not in lc]
+    rhs_free = [i for i in range(rhs_ndim) if i not in rc]
+    nlf, nrf = len(lhs_free), len(rhs_free)
+
+    dn_dlhs = ((tuple(range(nlf, nlf + nrf)), tuple(rhs_free)), ((), ()))
+    rc_sorted = sorted(rc)
+    pos = {i: a for a, i in enumerate(lhs_free)}
+    for j, i in enumerate(lc):
+        pos[i] = nlf + rc_sorted.index(rc[j])
+    perm_dlhs = [pos[i] for i in range(lhs_ndim)]
+
+    dn_drhs = ((tuple(lhs_free), tuple(range(nlf))), ((), ()))
+    lc_sorted = sorted(lc)
+    pos = {i: len(lc) + b for b, i in enumerate(rhs_free)}
+    for j, i in enumerate(rc):
+        pos[i] = lc_sorted.index(lc[j])
+    perm_drhs = [pos[i] for i in range(rhs_ndim)]
+    return dn_dlhs, perm_dlhs, dn_drhs, perm_drhs
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _f8_dot(lhs, rhs, dimension_numbers, fwd_dtype, bwd_dtype, out_dtype,
+            lhs_dtype, rhs_dtype):
+    out, _ = _f8_dot_fwd(lhs, rhs, dimension_numbers, fwd_dtype, bwd_dtype,
+                         out_dtype, lhs_dtype, rhs_dtype)
+    return out
+
+
+def _f8_dot_fwd(lhs, rhs, dimension_numbers, fwd_dtype, bwd_dtype, out_dtype,
+                lhs_dtype, rhs_dtype):
+    ql, sl = _quant(lhs, fwd_dtype, _F8_MAX[fwd_dtype])
+    qr, sr = _quant(rhs, fwd_dtype, _F8_MAX[fwd_dtype])
+    out = lax.dot_general(
+        ql, qr, dimension_numbers, preferred_element_type=jnp.float32
+    ) * (sl * sr)
+    # Residuals are the f8 tensors — half the fwd-activation memory of the
+    # QDQ formulation, which autodiff makes save the bf16 dequantized values.
+    return out.astype(out_dtype), (ql, sl, qr, sr)
+
+
+def _f8_dot_bwd(dimension_numbers, fwd_dtype, bwd_dtype, out_dtype, lhs_dtype,
+                rhs_dtype, res, g):
+    ql, sl, qr, sr = res
+    qg, sg = _quant(g, bwd_dtype, _F8_MAX[bwd_dtype])
+    dn_dlhs, perm_dlhs, dn_drhs, perm_drhs = _grad_dns(
+        dimension_numbers, ql.ndim, qr.ndim
+    )
+    dlhs = lax.dot_general(
+        qg, qr, dn_dlhs, preferred_element_type=jnp.float32
+    ) * (sg * sr)
+    drhs = lax.dot_general(
+        ql, qg, dn_drhs, preferred_element_type=jnp.float32
+    ) * (sl * sg)
+    return (
+        jnp.transpose(dlhs, perm_dlhs).astype(lhs_dtype),
+        jnp.transpose(drhs, perm_drhs).astype(rhs_dtype),
+    )
+
+
+_f8_dot.defvjp(_f8_dot_fwd, _f8_dot_bwd)
+
+
+def fp8_dot_general(fp8_format: str = "HYBRID", use_during_eval: bool = False,
+                    native: Optional[bool] = None):
+    """Returns a drop-in ``lax.dot_general`` replacement computing in fp8.
+    Plug into ``nn.Dense(dot_general=...)``.
 
     fp8_format: "E4M3" (fwd+bwd in e4m3), "E5M2" (everything e5m2, rarely
     useful), or "HYBRID" (e4m3 fwd / e5m2 bwd — the default recipe).
     use_during_eval=False (recipe default) keeps full precision when tracing
     inside :func:`eval_mode`.
+
+    native=True (the default; env override ``ACCELERATE_FP8_NATIVE=0``)
+    emits true float8-operand ``dot_general`` s — forward AND both cotangent
+    dots — so hardware with fp8 MXU/TC paths runs them natively and XLA
+    legalizes them to bf16 elsewhere; f8 residuals also halve saved-activation
+    memory. The QDQ formulation (quantize-dequantize around a bf16 dot) is
+    kept for batch-dim dot_generals, which the native transpose rules don't
+    cover. Reference counterpart: utils/transformer_engine.py:26-186 (TE
+    fp8_autocast swap) and the BASELINE.md fp8 +25% row.
     """
     fmt = fp8_format.upper()
     if fmt == "HYBRID":
@@ -110,6 +235,11 @@ def fp8_dot_general(fp8_format: str = "HYBRID", use_during_eval: bool = False):
         q = qdq_e5m2
     else:
         raise ValueError(f"fp8_format must be E4M3|E5M2|HYBRID, got {fp8_format}")
+    if native is None:
+        import os
+
+        native = os.environ.get("ACCELERATE_FP8_NATIVE", "1") != "0"
+    fwd_dt, bwd_dt = _fmt_dtypes(fmt)
 
     def dot_general(lhs, rhs, dimension_numbers, precision=None,
                     preferred_element_type: Optional[jnp.dtype] = None):
@@ -118,6 +248,11 @@ def fp8_dot_general(fp8_format: str = "HYBRID", use_during_eval: bool = False):
                 lhs, rhs, dimension_numbers,
                 precision=precision, preferred_element_type=preferred_element_type,
             )
+        batch_dims = dimension_numbers[1]
+        if native and not (batch_dims[0] or batch_dims[1]):
+            out_dtype = jnp.dtype(preferred_element_type or jnp.result_type(lhs, rhs))
+            return _f8_dot(lhs, rhs, dimension_numbers, fwd_dt, bwd_dt,
+                           out_dtype, jnp.dtype(lhs.dtype), jnp.dtype(rhs.dtype))
         return lax.dot_general(
             q(lhs), q(rhs), dimension_numbers,
             precision=precision, preferred_element_type=preferred_element_type,

@@ -214,9 +214,19 @@ class FP8RecipeKwargs(KwargsHandler):
     """fp8 matmul recipe (reference: TERecipeKwargs/AORecipeKwargs,
     utils/dataclasses.py:312-484). On TPU this selects XLA float8 dots:
     activations/weights quantized per-tensor with delayed or current scaling,
-    master weights bf16/fp32."""
+    master weights bf16/fp32.
+
+    ``backend`` mirrors the reference's AO→TE→MSAMP auto-pick
+    (reference: accelerator.py:478-503): "TE" and "AO" both map to the
+    native float8-operand dot path (ops/fp8.py ``_f8_dot`` — TE's HYBRID
+    GEMM recipe and torchao's dynamic-scaling Float8Linear are the same
+    computation under XLA), "QDQ" forces the quantize-dequantize
+    formulation, and "AUTO" lets the platform decide. "MSAMP" raises:
+    MS-AMP is deprecated upstream and deliberately dropped here (see
+    COVERAGE.md, deliberate drops)."""
 
     fp8_format: str = "HYBRID"  # E4M3 fwd / E5M2 bwd when HYBRID
+    backend: str = "AUTO"       # AUTO | TE | AO | QDQ (MSAMP: rejected)
     amax_history_len: int = 16
     amax_compute_algo: str = "max"
     margin: int = 0
@@ -226,6 +236,17 @@ class FP8RecipeKwargs(KwargsHandler):
         self.fp8_format = self.fp8_format.upper()
         if self.fp8_format not in FP8Format.list():
             raise ValueError(f"fp8_format must be one of {FP8Format.list()}")
+        self.backend = self.backend.upper()
+        from ..ops.fp8 import backend_to_native
+
+        backend_to_native(self.backend)  # validates (MSAMP rejected here)
+
+    @property
+    def native_dots(self) -> "bool | None":
+        """None = platform default (ACCELERATE_FP8_NATIVE env)."""
+        from ..ops.fp8 import backend_to_native
+
+        return backend_to_native(self.backend)
 
 
 @dataclass

@@ -66,6 +66,80 @@ def test_fp8_dot_general_gradients_flow():
     assert cos > 0.98, cos
 
 
+def test_native_f8_dots_in_hlo_fwd_and_bwd():
+    """backend TE/AO: forward AND both cotangent dots must have true float8
+    operand types (the reference gets this from TE fp8 GEMMs; here XLA runs
+    them natively on fp8-capable targets and legalizes elsewhere)."""
+    from accelerate_tpu.ops import fp8_dot_general
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 4, 8)).astype(np.float32))
+    dn = (((2,), (0,)), ((), ()))
+    nat = fp8_dot_general("HYBRID", native=True)
+    txt = (
+        jax.jit(jax.value_and_grad(lambda x, w: jnp.sum(nat(x, w, dn)), argnums=(0, 1)))
+        .lower(x, w)
+        .as_text()
+    )
+    dots = [l for l in txt.splitlines() if "dot_general" in l]
+    f8_dots = [l for l in dots if "f8E4M3" in l or "f8E5M2" in l]
+    assert len(f8_dots) == 3, (len(f8_dots), dots)
+    # HYBRID: cotangent enters the grad dots as e5m2.
+    assert sum("f8E5M2" in l for l in f8_dots) == 2, f8_dots
+
+
+@pytest.mark.parametrize("dn", [
+    (((1,), (0,)), ((), ())),                # plain matmul
+    (((2,), (0,)), ((), ())),                # DenseGeneral qkv style
+    (((2, 3), (0, 1)), ((), ())),            # DenseGeneral o_proj style
+    (((0,), (2,)), ((), ())),                # unsorted/odd contraction dims
+])
+def test_native_f8_grads_match_qdq_shapes_and_direction(dn):
+    """The hand-written dot transposes must agree with autodiff's (shape
+    exactly; value within fp8 rounding — native quantizes the cotangent
+    BEFORE the grad dot, TE-style, QDQ after, so bitwise equality is not
+    expected)."""
+    from accelerate_tpu.ops import fp8_dot_general
+
+    rng = np.random.default_rng(3)
+    (lc, rc), _ = dn
+    shapes = {
+        ((1,), (0,)): ((8, 16), (16, 4)),
+        ((2,), (0,)): ((2, 8, 16), (16, 4, 8)),
+        ((2, 3), (0, 1)): ((2, 8, 4, 8), (4, 8, 16)),
+        ((0,), (2,)): ((16, 8), (4, 2, 16)),
+    }[(lc, rc)]
+    x = jnp.asarray(rng.normal(size=shapes[0]).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=shapes[1]).astype(np.float32))
+    nat = fp8_dot_general("HYBRID", native=True)
+    ref = fp8_dot_general("HYBRID", native=False)
+    np.testing.assert_allclose(
+        np.asarray(nat(x, w, dn)), np.asarray(ref(x, w, dn)), rtol=1e-4, atol=1e-4
+    )
+    gn = jax.grad(lambda x, w: jnp.sum(nat(x, w, dn) ** 2), argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(ref(x, w, dn) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(gn, gr):
+        assert a.shape == b.shape
+        cos = float(jnp.sum(a * b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+        assert cos > 0.99, cos
+
+
+def test_fp8_backend_aliases():
+    """Reference parity for the backend surface (accelerator.py:478-503):
+    TE/AO → native f8 dots, QDQ → simulation, MSAMP → explicit rejection."""
+    from accelerate_tpu.utils import FP8RecipeKwargs
+
+    assert FP8RecipeKwargs(backend="TE").native_dots is True
+    assert FP8RecipeKwargs(backend="ao").native_dots is True
+    assert FP8RecipeKwargs(backend="QDQ").native_dots is False
+    assert FP8RecipeKwargs().native_dots is None  # AUTO → platform default
+    with pytest.raises(ValueError, match="MS-AMP"):
+        FP8RecipeKwargs(backend="MSAMP")
+    with pytest.raises(ValueError, match="AUTO"):
+        FP8RecipeKwargs(backend="nonsense")
+
+
 def test_quantize_params_roundtrip():
     from accelerate_tpu.ops import dequantize_params_fp8, quantize_params_fp8
 
