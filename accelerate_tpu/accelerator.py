@@ -575,7 +575,70 @@ class Accelerator:
                 result.append(self.prepare_scheduler(obj))
             else:
                 result.append(obj)
+        self._maybe_elastic_resume()
         return result[0] if len(result) == 1 else tuple(result)
+
+    def _maybe_elastic_resume(self) -> None:
+        """Elastic auto-resume: when the launcher restarted the gang
+        (ACCELERATE_RESTART_ATTEMPT > 0, commands/launch.py gang loop) and
+        the project saves automatic checkpoints, restore the latest one
+        right after prepare() — a restarted run must continue, not silently
+        train from scratch. Opt-in via
+        ProjectConfiguration(automatic_resume=True); reference analog:
+        torch elastic restarts (launch.py:998-1030) + the script-side
+        resume_from_checkpoint idiom."""
+        pc = self.project_configuration
+        if not (pc.automatic_resume and pc.automatic_checkpoint_naming):
+            return
+        if getattr(self, "_elastic_resumed", False):
+            # Staged prepares: dataloaders registered AFTER the resume still
+            # need their checkpointed sampler/epoch state. Safe to re-apply
+            # the host-side restore only while no training has happened
+            # since the resume (the params/opt rewind hazard needs steps).
+            resume_dir = getattr(self, "_elastic_resume_dir", None)
+            if (
+                resume_dir is not None
+                and len(self._dataloaders) > getattr(self, "_elastic_resume_n_loaders", 0)
+                and int(np.asarray(self._train_state.step))
+                == getattr(self, "_elastic_resume_step", -1)
+            ):
+                from .checkpointing import _load_host_side_state
+
+                _load_host_side_state(self, resume_dir)
+                self._elastic_resume_n_loaders = len(self._dataloaders)
+            return
+        attempt = int(os.environ.get("ACCELERATE_RESTART_ATTEMPT", "0") or 0)
+        if attempt <= 0:
+            return
+        # Wait for a prepare() that produced a *trainable* state: a staged
+        # script may prepare dataloaders (no train state) or a frozen model
+        # (no tx) first — resuming then would crash or skip the optimizer
+        # moments, and the consumed flag would block the real resume later.
+        state = self._train_state
+        if state is None or state.tx is None:
+            return
+        # From here the decision is final for this process, including the
+        # fresh-start path: a later prepare() call mid-training must never
+        # rewind to a checkpoint the run itself has since written.
+        self._elastic_resumed = True
+        base = os.path.join(self.project_dir or ".", "checkpoints")
+        if not os.path.isdir(base) or not any(
+            f.startswith("checkpoint_") for f in os.listdir(base)
+        ):
+            logger.warning(
+                "automatic_resume: restart attempt %d but no checkpoints under "
+                "%s — starting fresh.", attempt, base,
+            )
+            return
+        loaded = self.load_state()
+        self._elastic_resume_dir = loaded
+        self._elastic_resume_n_loaders = len(self._dataloaders)
+        self._elastic_resume_step = int(np.asarray(self._train_state.step))
+        logger.info(
+            "automatic_resume: restart attempt %d resumed from %s (step %d)",
+            attempt, loaded, self._elastic_resume_step,
+            main_process_only=True,
+        )
 
     def _apply_activation_checkpointing(self, model: Model):
         """Honor ``fsdp_plugin.activation_checkpointing`` (reference FSDP

@@ -51,6 +51,13 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = Fal
             )
             if not folders:
                 raise FileNotFoundError(f"No checkpoints found in {base}")
+            # Continue numbering past the checkpoint being restored so the
+            # next save doesn't clobber checkpoint_0 (reference:
+            # accelerator.py load_state sets iteration = current + 1). Done
+            # here — the single resolution point — because load_state may
+            # pre-resolve for its pre-hooks, after which
+            # load_accelerator_state sees a non-None input_dir.
+            pc.iteration = int(folders[-1].split("_")[1]) + 1
             return os.path.join(base, folders[-1])
         out = os.path.join(base, f"checkpoint_{pc.iteration}")
         return out

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 
 from ..utils.other import convert_bytes
 
@@ -56,14 +57,8 @@ def _params_from_safetensors(path: str) -> tuple[int, int]:
     return total, largest
 
 
-def _params_from_builtin(spec: str):
-    """'llama:7b' / 'llama:1b' / 'llama:tiny' / 'mixtral:tiny' →
-    (total, largest) via jax.eval_shape (no FLOPs, no memory)."""
-    import jax
-    import numpy as np
-
-    from ..utils.modeling import compute_abstract_params, named_parameter_shapes
-
+def _builtin_module(spec: str):
+    """'llama:7b' etc. → (config, flax module) — no weights materialized."""
     family, _, size = spec.partition(":")
     size = size or "tiny"
     if family == "llama":
@@ -97,6 +92,17 @@ def _params_from_builtin(spec: str):
         module = GPT2LMHeadModel(ctor[size]())
     else:
         raise KeyError(family)
+    return module.config, module
+
+
+def _params_from_builtin(spec: str):
+    """'llama:7b' / 'llama:1b' / 'llama:tiny' / 'mixtral:tiny' →
+    (total, largest) via jax.eval_shape (no FLOPs, no memory)."""
+    import numpy as np
+
+    from ..utils.modeling import compute_abstract_params, named_parameter_shapes
+
+    _, module = _builtin_module(spec)
     ids = np.zeros((1, 8), dtype=np.int32)
     abstract = compute_abstract_params(module, ids)
     shapes = named_parameter_shapes(abstract)
@@ -155,7 +161,103 @@ def estimate_memory(model: str, dtypes: list[str]) -> list[dict]:
     return rows
 
 
+def _parse_parallelism(spec: str):
+    """'dp_shard=64,tp=2' → ParallelismConfig."""
+    from ..parallelism_config import ParallelismConfig
+
+    kwargs = {}
+    for part in spec.split(","):
+        axis, _, deg = part.partition("=")
+        axis = axis.strip()
+        if not axis:
+            continue
+        key = axis if axis.endswith("_size") else f"{axis}_size"
+        kwargs[key] = int(deg)
+    return ParallelismConfig(**kwargs)
+
+
+def estimate_topology_command(args: argparse.Namespace) -> int:
+    """Per-chip HBM under a ParallelismConfig — the number a TPU user
+    actually needs, computed with the trainer's own sharding planner
+    (utils/estimate_memory.py; beats the reference's whole-model table,
+    commands/estimate.py:66-318)."""
+    import numpy as np
+
+    from ..utils.estimate_memory import (
+        build_abstract_mesh,
+        estimate_per_chip,
+        replicated_large_leaves,
+    )
+
+    if args.dtypes[0] not in ("fp32", "bf16", "fp16"):
+        print(
+            f"--parallelism estimates the TRAINING working set; master "
+            f"weights are fp32/bf16/fp16, never {args.dtypes[0]!r} (fp8 is "
+            f"per-matmul compute, int8/int4 are inference-only storage). "
+            f"Pick a float dtype, or drop --parallelism for the whole-model "
+            f"table.",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        cfg, module = _builtin_module(args.model_name)
+        if getattr(args, "remat", False) and hasattr(cfg, "remat"):
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, remat=True)
+            module = type(module)(cfg)
+    except KeyError:
+        print(
+            f"--parallelism needs a builtin model spec (e.g. 'llama:7b', "
+            f"'llama:1b') to instantiate the sharding planner; got "
+            f"{args.model_name!r}. Drop --parallelism for the whole-model "
+            f"table, which also accepts safetensors paths and HF ids.",
+            file=sys.stderr,
+        )
+        return 2
+    pc = _parse_parallelism(args.parallelism)
+    dt = {"fp32": np.float32, "bf16": "bfloat16", "fp16": np.float16}[args.dtypes[0]]
+    tp_rules = None
+    if pc.tp_size > 1:
+        family = args.model_name.partition(":")[0]
+        if family == "llama":
+            from ..models.llama import llama_tp_rules
+
+            tp_rules = llama_tp_rules(cfg.scan_layers)
+    est, shapes, shardings = estimate_per_chip(
+        module, cfg, pc, seq=args.seq, per_chip_batch=args.per_chip_batch,
+        optimizer=args.optimizer, master_dtype=dt, tp_rules=tp_rules,
+    )
+    replicated = replicated_large_leaves(shapes, shardings, build_abstract_mesh(pc))
+    fits = est.total_gib <= args.hbm_gib
+    if args.json:
+        print(json.dumps({
+            "model": args.model_name,
+            "parallelism": args.parallelism,
+            "seq": args.seq,
+            "per_chip": {
+                **{k.replace(" ", "_"): round(v, 4) for k, v in est.rows()},
+                "total_gib": round(est.total_gib, 4),
+                "fits": fits,
+                "hbm_gib": args.hbm_gib,
+            },
+            "replicated_large_leaves": replicated,
+        }))
+        return 0 if fits else 1
+    print(f"Per-chip estimate for `{args.model_name}` under {args.parallelism} "
+          f"(seq {args.seq}, batch/chip {args.per_chip_batch}, {args.optimizer}, "
+          f"{args.dtypes[0]} masters):")
+    for name, gib in est.rows():
+        print(f"  {name:22s} {gib:9.3f} GiB")
+    print(f"  fits {args.hbm_gib:.0f} GiB HBM: {'yes' if fits else 'NO'}")
+    if replicated:
+        print(f"  WARNING: large replicated leaves: {', '.join(replicated[:6])}")
+    return 0 if fits else 1
+
+
 def estimate_command(args: argparse.Namespace) -> int:
+    if getattr(args, "parallelism", None):
+        return estimate_topology_command(args)
     rows = estimate_memory(args.model_name, args.dtypes)
     if args.json:
         print(json.dumps(rows))
@@ -183,5 +285,19 @@ def add_parser(subparsers) -> argparse.ArgumentParser:
     )
     p.add_argument("--dtypes", nargs="+", default=["fp32", "bf16", "fp8"], choices=list(DTYPE_BYTES))
     p.add_argument("--json", action="store_true", help="Machine-readable output")
+    p.add_argument(
+        "--parallelism", default=None,
+        help="Topology mode: per-chip HBM under e.g. 'dp_shard=64,tp=2' "
+             "(builtin model specs only; uses the trainer's sharding planner)",
+    )
+    p.add_argument("--seq", type=int, default=2048, help="Sequence length (topology mode)")
+    p.add_argument("--per-chip-batch", dest="per_chip_batch", type=int, default=1)
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "adam", "sgd", "momentum", "lion", "adafactor"])
+    p.add_argument("--hbm-gib", dest="hbm_gib", type=float, default=16.0,
+                   help="Per-chip HBM budget to check against (v5e: 16)")
+    p.add_argument("--remat", action="store_true",
+                   help="Estimate with activation rematerialization on "
+                        "(topology mode; the training-recipe default)")
     p.set_defaults(func=estimate_command)
     return p

@@ -106,9 +106,9 @@ class Const:
 
 
 def _np(t) -> np.ndarray:
-    if hasattr(t, "detach"):
-        t = t.detach().cpu().float().numpy()
-    return np.asarray(t)
+    from .hub import _np as hub_np
+
+    return hub_np(t)
 
 
 def _cfg_get(hf_cfg, key, default=None):
@@ -245,25 +245,16 @@ def validate_against_module(cfg, params, module_cls) -> None:
     of the reference's load_checkpoint_in_model unexpected/missing keys."""
     import jax
 
+    from ..utils.modeling import named_parameter_shapes
+
     module = module_cls(cfg)
     ref_shapes = jax.eval_shape(
         lambda: module.init(
             jax.random.key(0), np.zeros((1, 8), np.int32)
         )["params"]
     )
-
-    def flatten(tree, prefix=""):
-        out = {}
-        for k, v in tree.items():
-            path = f"{prefix}/{k}" if prefix else k
-            if isinstance(v, dict):
-                out.update(flatten(v, path))
-            else:
-                out[path] = tuple(v.shape)
-        return out
-
-    got = flatten(params)
-    want = flatten(ref_shapes)
+    got = {k: tuple(v.shape) for k, v in named_parameter_shapes(params).items()}
+    want = {k: tuple(v.shape) for k, v in named_parameter_shapes(ref_shapes).items()}
     problems = []
     for path in sorted(set(want) - set(got)):
         problems.append(f"missing {path} {want[path]}")

@@ -31,11 +31,12 @@ def collate(samples):
     return np.asarray(samples)
 
 
-def run(split_batches):
+def run(split_batches, dispatch_group_size=8):
     dl = DataLoaderDispatcher(
         DS(),
         batch_sampler=BatchSampler(SequentialSampler(N), batch_size=BS, drop_last=False),
         split_batches=split_batches,
+        dispatch_group_size=dispatch_group_size,
         collate_fn=collate,
         device_placement=False,
     )
@@ -60,6 +61,32 @@ for b in batches:
 for k, b in enumerate(batches):
     expect = data[k * BS + rank * (BS // world): k * BS + (rank + 1) * (BS // world)]
     assert np.array_equal(b, expect), (rank, k, b, expect)
+
+# --- grouped broadcast is semantics-free: group sizes 1 and 8 agree ---------
+# (the group only changes the collective cadence; same batches, same order;
+# N=24 makes the last group partial, exercising the tail path)
+for split in (False, True):
+    a = run(split_batches=split, dispatch_group_size=1)
+    b = run(split_batches=split, dispatch_group_size=8)
+    assert len(a) == len(b), (split, len(a), len(b))
+    for k, (x, y) in enumerate(zip(a, b)):
+        assert np.array_equal(x, y), (split, k, x, y)
+
+# --- byte cap truncates a group WITHOUT ending the epoch --------------------
+dl = DataLoaderDispatcher(
+    DS(),
+    batch_sampler=BatchSampler(SequentialSampler(N), batch_size=BS, drop_last=False),
+    split_batches=False,
+    dispatch_group_size=8,
+    collate_fn=collate,
+    device_placement=False,
+)
+dl.dispatch_group_bytes = 1  # every batch overflows the cap -> group of 1
+capped = [np.asarray(b) for b in dl]
+ref = run(split_batches=False, dispatch_group_size=1)
+assert len(capped) == len(ref), (len(capped), len(ref))
+for x, y in zip(capped, ref):
+    assert np.array_equal(x, y)
 
 if acc.is_main_process:
     print("TEST_DISPATCH OK")

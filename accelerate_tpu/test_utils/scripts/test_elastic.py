@@ -1,0 +1,74 @@
+"""Launched check: gang restart + automatic_resume.
+
+Attempt 0 trains 3 steps, checkpointing each one, then simulates a hardware
+failure (one rank exits non-zero; the launcher kills the rest and restarts
+the whole gang — commands/launch.py's elastic loop). Attempt 1 must find the
+latest automatic checkpoint via ProjectConfiguration(automatic_resume=True)
+and CONTINUE from step 3 instead of silently retraining from scratch.
+
+Reference analog: torch elastic max_restarts (launch.py:998-1030) plus the
+script-side resume_from_checkpoint idiom — here the resume is framework-owned.
+"""
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, Model
+from accelerate_tpu.test_utils.training import make_regression_model
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+work = os.environ["ELASTIC_TEST_DIR"]
+attempt = int(os.environ.get("ACCELERATE_RESTART_ATTEMPT", "0") or 0)
+
+set_seed(0)
+acc = Accelerator(
+    project_config=ProjectConfiguration(
+        project_dir=work,
+        automatic_checkpoint_naming=True,
+        automatic_resume=True,
+    )
+)
+rank, world = acc.process_index, acc.num_processes
+assert world > 1
+
+module, loss_fn = make_regression_model()
+model = Model.from_flax(module, jax.random.key(0), np.zeros((4,), np.float32))
+model, _ = acc.prepare(model, optax.adam(1e-2))
+step_fn = acc.prepare_train_step(loss_fn)
+
+x = np.linspace(-1, 1, 8).astype(np.float32)
+batch = {"x": x, "y": (3 * x).astype(np.float32)}
+
+start = int(np.asarray(acc.train_state.step))
+if attempt == 0:
+    assert start == 0, f"fresh run must start at 0, got {start}"
+else:
+    assert getattr(acc, "_elastic_resumed", False), "attempt>0 did not resume"
+    assert start == 3, f"resume must continue from step 3, got {start}"
+    # Numbering continues past the restored checkpoint — no clobbering.
+    assert acc.project_configuration.iteration == 3
+
+TOTAL, FAIL_AFTER = 6, 3
+state = acc.train_state
+for i in range(start, TOTAL):
+    state, _ = step_fn(state, batch)
+    acc._train_state = state
+    acc.save_state()
+    if attempt == 0 and i + 1 == FAIL_AFTER:
+        acc.wait_for_everyone()  # every rank's checkpoint write is done
+        if rank == world - 1:
+            print(f"[elastic] rank {rank} simulating hardware failure", flush=True)
+            os._exit(17)
+        # Surviving ranks idle until the launcher tears the gang down.
+        time.sleep(300)
+        sys.exit("launcher failed to terminate surviving ranks")
+
+assert int(np.asarray(acc.train_state.step)) == TOTAL
+ckpts = sorted(os.listdir(os.path.join(work, "checkpoints")))
+assert len(ckpts) == TOTAL, ckpts  # 0..2 from attempt 0, 3..5 after resume
+if acc.is_main_process:
+    print("Elastic resume test passed", flush=True)

@@ -501,6 +501,12 @@ class ProjectConfiguration(KwargsHandler):
     total_limit: int = None
     iteration: int = 0
     save_on_each_node: bool = False
+    # Elastic auto-resume (opt-in): on a gang restart
+    # (ACCELERATE_RESTART_ATTEMPT > 0, commands/launch.py) the Accelerator
+    # load_state()s the latest automatic checkpoint right after prepare(),
+    # so a restarted run continues instead of silently training from scratch
+    # (reference: torch elastic restarts, commands/launch.py:998-1030).
+    automatic_resume: bool = False
 
     def set_directories(self, project_dir: str = None):
         self.project_dir = project_dir

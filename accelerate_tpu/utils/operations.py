@@ -334,6 +334,15 @@ def broadcast(tensor, from_process: int = 0):
     return recursively_apply(_bcast, tensor)
 
 
+# One collective costs the same for any payload up to ~1 MB (fixed dispatch
+# cost dominates; benchmarks/input_pipeline_bench.py), so small objects ride
+# a single fixed-size broadcast with the length inline — halving the fixed
+# cost vs the naive length-round-then-data protocol. Larger payloads fall
+# back to a second, exact-size collective; the header makes the decision
+# from broadcast content, so every rank takes the same branch.
+_BCAST_INLINE_BUCKET = 1 << 16
+
+
 def broadcast_object_list(object_list: list, from_process: int = 0):
     """Broadcast a list of picklable objects from one process
     (reference: utils/operations.py:496-516)."""
@@ -342,15 +351,25 @@ def broadcast_object_list(object_list: list, from_process: int = 0):
         return object_list
     from jax.experimental import multihost_utils
 
-    payload = pickle.dumps(list(object_list))
-    local_len = np.array([len(payload)], dtype=np.int64)
     is_src = state.process_index == from_process
-    max_len = int(multihost_utils.broadcast_one_to_all(local_len, is_source=is_src)[0])
-    buf = np.zeros((max_len,), dtype=np.uint8)
+    payload = pickle.dumps(list(object_list)) if is_src else b""
+    buf = np.zeros((8 + _BCAST_INLINE_BUCKET,), dtype=np.uint8)
     if is_src:
-        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
-    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
-    result = pickle.loads(np.asarray(out).tobytes())
+        buf[:8] = np.frombuffer(
+            np.int64(len(payload)).tobytes(), dtype=np.uint8
+        )
+        if len(payload) <= _BCAST_INLINE_BUCKET:
+            buf[8: 8 + len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=is_src))
+    need = int(np.frombuffer(out[:8].tobytes(), dtype=np.int64)[0])
+    if need <= _BCAST_INLINE_BUCKET:
+        result = pickle.loads(out[8: 8 + need].tobytes())
+    else:
+        big = np.zeros((need,), dtype=np.uint8)
+        if is_src:
+            big[:] = np.frombuffer(payload, dtype=np.uint8)
+        out2 = multihost_utils.broadcast_one_to_all(big, is_source=is_src)
+        result = pickle.loads(np.asarray(out2).tobytes())
     for i, v in enumerate(result):
         object_list[i] = v
     return object_list

@@ -111,6 +111,21 @@ def test_launched_test_script_multiprocess():
     assert "All launched checks passed" in out
 
 
+def test_launched_elastic_auto_resume(tmp_path):
+    """Kill one rank mid-run → the launcher restarts the gang → attempt 1
+    auto-resumes from the latest automatic checkpoint (assertions inside
+    test_utils/scripts/test_elastic.py)."""
+    from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+    cmd = get_launch_command(
+        num_processes=2, virtual_devices=2, max_restarts=1
+    ) + ["-m", "accelerate_tpu.test_utils.scripts.test_elastic"]
+    out = execute_subprocess(
+        cmd, env={"PYTHONPATH": os.getcwd(), "ELASTIC_TEST_DIR": str(tmp_path)}
+    )
+    assert "Elastic resume test passed" in out
+
+
 def test_launch_single_process_env(tmp_path):
     script = tmp_path / "show_env.py"
     script.write_text(

@@ -1,0 +1,125 @@
+"""Topology-aware memory estimator: the tensor-state categories must match
+the real sharded arrays byte-for-byte (same planner → no drift), and the CLI
+surface must expose it."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.estimate_memory import (
+    GiB,
+    build_abstract_mesh,
+    estimate_per_chip,
+    replicated_large_leaves,
+    _tree_bytes_per_chip,
+)
+
+
+def _materialized_bytes_on_dev0(tree):
+    """Exact bytes device 0 holds for a pytree of sharded jax.Arrays."""
+    total = 0
+    dev0 = jax.devices()[0]
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for shard in leaf.addressable_shards:
+            if shard.device == dev0:
+                total += shard.data.nbytes
+    return total
+
+
+@pytest.mark.parametrize("pc_kwargs", [
+    {"dp_shard_size": 8},
+    {"dp_shard_size": 4, "tp_size": 2},
+    {"dp_replicate_size": 2, "dp_shard_size": 4},
+])
+def test_param_and_opt_bytes_match_materialized(pc_kwargs):
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    set_seed(0)
+    from accelerate_tpu.models import llama_tp_rules
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    pc = ParallelismConfig(**pc_kwargs)
+    rules = llama_tp_rules(cfg.scan_layers) if pc.tp_size > 1 else None
+    est, shapes, shardings = estimate_per_chip(
+        module, cfg, pc, seq=16, per_chip_batch=1, optimizer="adamw",
+        tp_rules=rules,
+    )
+
+    acc = Accelerator(parallelism_config=pc)
+    ids = np.zeros((8, 9), np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids, tp_rules=rules)
+    model, _ = acc.prepare(model, optax.adamw(1e-3))
+    got_params = _materialized_bytes_on_dev0(acc.train_state.params)
+    want_params = int(est.params_gib * GiB)
+    assert got_params == want_params, (got_params, want_params)
+
+    # Adam moments: 2 × params bytes, same shardings (counts are scalars).
+    moment_tree = [
+        leaf for leaf in jax.tree_util.tree_leaves(acc.train_state.opt_state)
+        if hasattr(leaf, "shape") and leaf.ndim > 0
+    ]
+    got_opt = _materialized_bytes_on_dev0(moment_tree)
+    want_opt = int(est.opt_state_gib * GiB)
+    assert got_opt == want_opt, (got_opt, want_opt)
+
+
+def test_replicated_leaf_detector():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    pc = ParallelismConfig(dp_replicate_size=8)  # DDP: everything replicated
+    est, shapes, shardings = estimate_per_chip(module, cfg, pc, seq=16)
+    mesh = build_abstract_mesh(pc)
+    bad = replicated_large_leaves(shapes, shardings, mesh, min_bytes=2 ** 16)
+    assert any("embed_tokens" in b for b in bad)  # replication detected
+
+    pc2 = ParallelismConfig(dp_shard_size=8)  # FSDP: large leaves sharded
+    _, shapes2, shardings2 = estimate_per_chip(module, cfg, pc2, seq=16)
+    assert replicated_large_leaves(
+        shapes2, shardings2, build_abstract_mesh(pc2), min_bytes=2 ** 16
+    ) == []
+
+
+def test_7b_v5e64_fits_hbm_abstractly():
+    """The BASELINE.md contract shape: 7B FSDP on a v5e-64 — estimated from
+    the same planner the trainer uses, no devices required."""
+    cfg = LlamaConfig.llama_7b(dtype=jnp.bfloat16, remat=True)
+    module = LlamaForCausalLM(cfg)
+    pc = ParallelismConfig(dp_shard_size=64)
+    est, shapes, shardings = estimate_per_chip(
+        module, cfg, pc, seq=2048, per_chip_batch=1,
+        master_dtype=jnp.bfloat16, moments_dtype=jnp.bfloat16,
+    )
+    assert replicated_large_leaves(shapes, shardings, build_abstract_mesh(pc)) == []
+    assert est.params_gib * 64 > 11  # ~6.7B params in bf16 ≈ 12.5 GiB global
+    assert est.total_gib < 16, est.rows()
+
+
+def test_estimate_cli_parallelism(capsys):
+    from accelerate_tpu.commands.estimate import estimate_command
+
+    import argparse
+
+    args = argparse.Namespace(
+        model_name="llama:7b", dtypes=["bf16"], json=True,
+        parallelism="dp_shard=64", seq=2048, per_chip_batch=1,
+        optimizer="adamw", hbm_gib=16.0,
+    )
+    rc = estimate_command(args)
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json as _json
+
+    payload = _json.loads(out)
+    assert payload["per_chip"]["total_gib"] < 16
+    assert payload["per_chip"]["fits"] is True
