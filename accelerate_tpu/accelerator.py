@@ -591,21 +591,21 @@ class Accelerator:
         if not (pc.automatic_resume and pc.automatic_checkpoint_naming):
             return
         if getattr(self, "_elastic_resumed", False):
-            # Staged prepares: dataloaders registered AFTER the resume still
-            # need their checkpointed sampler/epoch state. Safe to re-apply
-            # the host-side restore only while no training has happened
-            # since the resume (the params/opt rewind hazard needs steps).
+            # Staged prepares: dataloaders/schedulers/custom objects
+            # registered AFTER the resume still need their checkpointed
+            # host-side state. Safe to re-apply only while no training has
+            # happened since the resume (the rewind hazard needs steps).
             resume_dir = getattr(self, "_elastic_resume_dir", None)
             if (
                 resume_dir is not None
-                and len(self._dataloaders) > getattr(self, "_elastic_resume_n_loaders", 0)
+                and self._host_state_counts() != getattr(self, "_elastic_resume_counts", None)
                 and int(np.asarray(self._train_state.step))
                 == getattr(self, "_elastic_resume_step", -1)
             ):
                 from .checkpointing import _load_host_side_state
 
                 _load_host_side_state(self, resume_dir)
-                self._elastic_resume_n_loaders = len(self._dataloaders)
+                self._elastic_resume_counts = self._host_state_counts()
             return
         attempt = int(os.environ.get("ACCELERATE_RESTART_ATTEMPT", "0") or 0)
         if attempt <= 0:
@@ -632,12 +632,21 @@ class Accelerator:
             return
         loaded = self.load_state()
         self._elastic_resume_dir = loaded
-        self._elastic_resume_n_loaders = len(self._dataloaders)
+        self._elastic_resume_counts = self._host_state_counts()
         self._elastic_resume_step = int(np.asarray(self._train_state.step))
         logger.info(
             "automatic_resume: restart attempt %d resumed from %s (step %d)",
             attempt, loaded, self._elastic_resume_step,
             main_process_only=True,
+        )
+
+    def _host_state_counts(self) -> tuple:
+        """Registration counts of everything _load_host_side_state restores
+        by enumeration — the staleness key for staged elastic resume."""
+        return (
+            len(self._dataloaders),
+            len(self._schedulers),
+            len(self._custom_objects),
         )
 
     def _apply_activation_checkpointing(self, model: Model):
@@ -934,6 +943,7 @@ class Accelerator:
             data_seed=cfg.data_seed,
             non_blocking=cfg.non_blocking,
             prefetch_size=cfg.prefetch_size,
+            dispatch_group_size=cfg.dispatch_group_size,
         )
         self._dataloaders.append(prepared)
         return prepared

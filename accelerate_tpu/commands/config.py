@@ -13,25 +13,29 @@ from .config_args import LaunchConfig, default_config_file
 
 
 def _ask(prompt: str, default, cast=str, choices=None):
-    suffix = f" [{default}]"
     if choices:
-        suffix = f" ({'/'.join(str(c) for c in choices)}){suffix}"
+        # Arrow-key selection on a TTY (the reference's commands/menu/ role),
+        # numbered-prompt fallback elsewhere — no enum typing either way.
+        from .menu import choose
+
+        return choose(prompt, choices, default)
     while True:
-        raw = input(f"{prompt}{suffix}: ").strip()
+        raw = input(f"{prompt} [{default}]: ").strip()
         if not raw:
             return default
         try:
-            val = cast(raw)
+            return cast(raw)
         except ValueError:
             print(f"  invalid value {raw!r}, expected {cast.__name__}")
-            continue
-        if choices and val not in choices:
-            print(f"  must be one of {choices}")
-            continue
-        return val
 
 
 def _ask_bool(prompt: str, default: bool) -> bool:
+    from .menu import menu_active
+
+    if menu_active():
+        from .menu import choose
+
+        return choose(prompt, ["yes", "no"], "yes" if default else "no") == "yes"
     raw = input(f"{prompt} (yes/no) [{'yes' if default else 'no'}]: ").strip().lower()
     if not raw:
         return default

@@ -162,17 +162,29 @@ def estimate_memory(model: str, dtypes: list[str]) -> list[dict]:
 
 
 def _parse_parallelism(spec: str):
-    """'dp_shard=64,tp=2' → ParallelismConfig."""
+    """'dp_shard=64,tp=2' → ParallelismConfig. Raises ValueError with the
+    offending token and the valid axes on any malformed part."""
     from ..parallelism_config import ParallelismConfig
 
+    valid = ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep", "pp")
     kwargs = {}
     for part in spec.split(","):
         axis, _, deg = part.partition("=")
-        axis = axis.strip()
-        if not axis:
+        axis = axis.strip().removesuffix("_size")
+        if not axis and not deg:
             continue
-        key = axis if axis.endswith("_size") else f"{axis}_size"
-        kwargs[key] = int(deg)
+        if axis not in valid:
+            raise ValueError(
+                f"--parallelism: unknown axis {axis!r} in {part!r} "
+                f"(valid: {', '.join(valid)})"
+            )
+        try:
+            kwargs[f"{axis}_size"] = int(deg)
+        except ValueError:
+            raise ValueError(
+                f"--parallelism: {part!r} needs the form <axis>=<int>, e.g. "
+                f"dp_shard=64"
+            ) from None
     return ParallelismConfig(**kwargs)
 
 
@@ -215,7 +227,11 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 2
-    pc = _parse_parallelism(args.parallelism)
+    try:
+        pc = _parse_parallelism(args.parallelism)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     dt = {"fp32": np.float32, "bf16": "bfloat16", "fp16": np.float16}[args.dtypes[0]]
     tp_rules = None
     if pc.tp_size > 1:

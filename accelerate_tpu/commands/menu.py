@@ -1,0 +1,182 @@
+"""Arrow-key selection menu for the config questionnaire.
+
+Reference analog: commands/menu/ (~450 LoC BulletMenu widget over cursor/
+keymap/input helpers). A single ~150-line termios implementation suffices:
+raw-mode key decoding, highlighted redraw in place, digit jumps, vim keys.
+Falls back to a numbered ``input()`` prompt on non-TTY stdin (CI, pipes,
+``yes |``-style scripting), so nothing ever blocks on a missing terminal.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Optional, Sequence
+
+_UP = "\x1b[A"
+_DOWN = "\x1b[B"
+_HIGHLIGHT = "\x1b[1;96m"  # bold bright-cyan
+_RESET = "\x1b[0m"
+
+
+class _raw_terminal:
+    """Hold raw mode for the WHOLE menu session. Toggling per key races
+    canonical-mode echo (keys typed between reads get echoed and mangled)
+    and setraw's TCSAFLUSH default would discard queued fast keystrokes."""
+
+    def __init__(self, fd: int):
+        self.fd = fd
+
+    def __enter__(self):
+        import termios
+        import tty
+
+        self._termios = termios
+        self._old = termios.tcgetattr(self.fd)
+        tty.setraw(self.fd, termios.TCSADRAIN)
+        return self
+
+    def __exit__(self, *exc):
+        self._termios.tcsetattr(self.fd, self._termios.TCSADRAIN, self._old)
+
+
+def _tty_reader() -> Callable[[], str]:
+    """Key reader for an already-raw stdin: returns one logical key per call
+    ('up', 'down', 'enter', 'q', digits, 'esc', 'other')."""
+    import select as _select
+
+    def _pending(fd, timeout=0.05) -> bool:
+        return bool(_select.select([fd], [], [], timeout)[0])
+
+    def _read1(fd) -> str:
+        # os.read, NOT sys.stdin.read: the TextIOWrapper buffers ahead, so
+        # after it swallows a whole escape sequence select() on the raw fd
+        # reports nothing pending and arrows decode as bare ESC.
+        return os.read(fd, 1).decode("utf-8", errors="ignore")
+
+    def read_key() -> str:
+        fd = sys.stdin.fileno()
+        ch = _read1(fd)
+        if ch == "\x1b":
+            # Bare Escape vs escape sequence: only read further bytes if
+            # they are already pending — a blocking read here would freeze
+            # the menu on a lone ESC press.
+            if not _pending(fd):
+                return "esc"
+            intro = _read1(fd)
+            if intro not in ("[", "O"):  # Alt+<key> etc.
+                return "esc"
+            # CSI/SS3: consume parameter bytes until the final byte
+            # (0x40-0x7e), so 3+-byte keys like Delete (\x1b[3~) don't
+            # leave stray bytes queued for the next question.
+            seq = ""
+            while _pending(fd):
+                seq += _read1(fd)
+                if "\x40" <= seq[-1] <= "\x7e":
+                    break
+            final = seq[-1] if seq else ""
+            if final == "A":  # covers CSI \x1b[A and SS3 \x1bOA arrows
+                return "up"
+            if final == "B":
+                return "down"
+            return "other"  # unknown sequence: ignore, don't exit
+        if ch in ("\r", "\n"):
+            return "enter"
+        if ch == "\x03":  # Ctrl-C
+            raise KeyboardInterrupt
+        return ch
+
+    return read_key
+
+
+def _render(title: str, choices: Sequence[str], cur: int, first: bool,
+            out) -> None:
+    if not first:
+        out.write(f"\x1b[{len(choices)}A")  # cursor up to redraw in place
+    if first and title:
+        out.write(f"{title} (arrows or j/k to move, digits to jump, enter to pick)\r\n")
+    for i, choice in enumerate(choices):
+        marker = "➔ " if i == cur else "  "
+        line = f"{marker}{choice}"
+        if i == cur:
+            line = f"{_HIGHLIGHT}{line}{_RESET}"
+        out.write(f"\x1b[2K{line}\r\n")  # clear line, rewrite (\r\n: OPOST is off in raw mode)
+    out.flush()
+
+
+def select(
+    title: str,
+    choices: Sequence[str],
+    default_index: int = 0,
+    reader: Optional[Callable[[], str]] = None,
+    out=None,
+) -> int:
+    """Interactive selection; returns the chosen index.
+
+    ``reader``/``out`` are injectable for tests. Keys: ↑/↓ (wrap-around),
+    k/j, 1-9 jump-and-select, enter picks, 'q'/esc keeps the default.
+    """
+    choices = list(choices)
+    if not choices:
+        raise ValueError("select() needs at least one choice")
+    out = out or sys.stdout
+
+    def _loop(read_key) -> int:
+        cur = max(0, min(default_index, len(choices) - 1))
+        first = True
+        while True:
+            _render(title, choices, cur, first, out)
+            first = False
+            key = read_key()
+            if key in ("up", "k"):
+                cur = (cur - 1) % len(choices)
+            elif key in ("down", "j"):
+                cur = (cur + 1) % len(choices)
+            elif key == "enter":
+                return cur
+            elif key in ("q", "esc"):
+                return max(0, min(default_index, len(choices) - 1))
+            elif key.isdigit() and 1 <= int(key) <= len(choices):
+                return int(key) - 1
+
+    if reader is not None:  # injected (tests): terminal mode is the caller's
+        return _loop(reader)
+    with _raw_terminal(sys.stdin.fileno()):
+        return _loop(_tty_reader())
+
+
+def menu_active() -> bool:
+    """Use the widget only on a real terminal; ACCELERATE_NO_MENU=1 forces
+    the plain numbered prompt (scripting / expect-style tests)."""
+    if os.environ.get("ACCELERATE_NO_MENU", "") in ("1", "true", "yes"):
+        return False
+    try:
+        return sys.stdin.isatty() and sys.stdout.isatty()
+    except Exception:
+        return False
+
+
+def choose(prompt: str, choices: Sequence, default) -> object:
+    """High-level entry for the questionnaire: arrow-key menu on a TTY,
+    numbered ``input()`` fallback elsewhere. Returns the chosen VALUE."""
+    values = list(choices)
+    labels = [str(v) for v in values]
+    default_index = values.index(default) if default in values else 0
+    if menu_active():
+        idx = select(prompt, labels, default_index=default_index)
+        print(f"{prompt}: {labels[idx]}")
+        return values[idx]
+    # Fallback: numbered prompt (never blocks on escape sequences).
+    print(prompt)
+    for i, label in enumerate(labels):
+        marker = "*" if i == default_index else " "
+        print(f"  {i + 1}.{marker} {label}")
+    while True:
+        raw = input(f"Pick 1-{len(values)} [{default_index + 1}]: ").strip()
+        if not raw:
+            return values[default_index]
+        if raw.isdigit() and 1 <= int(raw) <= len(values):
+            return values[int(raw) - 1]
+        if raw in labels:  # typing the value still works (old behavior)
+            return values[labels.index(raw)]
+        print(f"  invalid choice {raw!r}")

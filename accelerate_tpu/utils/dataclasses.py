@@ -489,6 +489,10 @@ class DataLoaderConfiguration(KwargsHandler):
     non_blocking: bool = True
     use_stateful_dataloader: bool = False
     prefetch_size: int = 2
+    # Dispatch mode: batches rank 0 ships per broadcast collective (the
+    # fixed collective cost amortizer, byte-capped inside the loader).
+    # 1 restores the one-collective-per-batch behavior.
+    dispatch_group_size: int = 8
 
 
 @dataclass
