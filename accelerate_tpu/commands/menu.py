@@ -57,6 +57,11 @@ def _tty_reader() -> Callable[[], str]:
     def read_key() -> str:
         fd = sys.stdin.fileno()
         ch = _read1(fd)
+        if ch == "":
+            # EOF/hangup (ssh drop, pty master closed): os.read returns b''
+            # immediately and forever — treat as "keep the default and
+            # leave" instead of busy-spinning on re-render + re-read.
+            return "esc"
         if ch == "\x1b":
             # Bare Escape vs escape sequence: only read further bytes if
             # they are already pending — a blocking read here would freeze

@@ -32,7 +32,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.llama import apply_rope, rms_norm, rotary_embedding
+from .models.llama import (
+    apply_partial_rope,
+    apply_rope,
+    layer_norm,
+    rms_norm,
+    rotary_embedding,
+)
 from .utils.quantization import DecodeQuant, dequantize_decode_kernel
 
 
@@ -98,20 +104,38 @@ def _out_proj(x, kernel):
     return jnp.einsum("bsnd,ndh->bsh", x, _kernel(kernel, x.dtype))
 
 
+def _dense(p, x):
+    y = x @ _kernel(p["kernel"], x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
 def _mlp(cfg, p, x):
-    gate = x @ _kernel(p["gate_proj"]["kernel"], x.dtype)
-    up = x @ _kernel(p["up_proj"]["kernel"], x.dtype)
-    act = (
-        jax.nn.silu if getattr(cfg, "hidden_act", "silu") == "silu"
-        else partial(jax.nn.gelu, approximate=True)
-    )
-    return (act(gate) * up) @ _kernel(p["down_proj"]["kernel"], x.dtype)
+    from .models.llama import activation_fn
+
+    act = activation_fn(getattr(cfg, "hidden_act", "silu"))
+    up = _dense(p["up_proj"], x)
+    if getattr(cfg, "mlp_gated", True):
+        hidden = act(_dense(p["gate_proj"], x)) * up
+    else:  # plain 2-layer MLP (StarCoder2-style chassis knob)
+        hidden = act(up)
+    return _dense(p["down_proj"], hidden)
 
 
 def _norm_w(cfg, w, like):
     """RMSNorm weight in compute dtype, honoring Gemma's (1+w) convention."""
     plus1 = 1.0 if getattr(cfg, "rms_norm_plus_one", False) else 0.0
     return (w + plus1).astype(like.dtype) if plus1 else w.astype(like.dtype)
+
+
+def _chassis_norm(cfg, p, x):
+    """Layer norm honoring the chassis knob: rmsnorm (default) or
+    mean-centered layernorm-with-bias — same numerics as training via the
+    shared functional helper (models/llama.py layer_norm)."""
+    if getattr(cfg, "norm_type", "rmsnorm") == "layernorm":
+        return layer_norm(x, p["weight"], p["bias"], cfg.rms_norm_eps)
+    return rms_norm(x, _norm_w(cfg, p["weight"], x), cfg.rms_norm_eps)
 
 
 def _embed_tokens(cfg, embed, ids):
@@ -121,18 +145,21 @@ def _embed_tokens(cfg, embed, ids):
     return x
 
 
-def _qkv_proj(attn, hn, cos, sin):
+def _qkv_proj(attn, hn, cos, sin, rotary_dim=None):
     """q/k (roped) + v projections for one Llama-family layer; carries
-    Qwen2-style attention biases when present."""
+    Qwen2-style attention biases when present. ``rotary_dim`` < head_dim
+    rotates only the leading dims (StableLM-style partial rotary)."""
     def proj(name):
         y = _proj(hn, attn[name]["kernel"])
         if "bias" in attn[name]:
             y = y + attn[name]["bias"].astype(y.dtype)
         return y
 
-    q = apply_rope(proj("q_proj"), cos, sin)
-    k = apply_rope(proj("k_proj"), cos, sin)
-    return q, k, proj("v_proj")
+    def rope(y):
+        rd = y.shape[-1] if rotary_dim is None else rotary_dim
+        return apply_partial_rope(y, cos, sin, rd)
+
+    return rope(proj("q_proj")), rope(proj("k_proj")), proj("v_proj")
 
 
 def _attend(q, k, v, q_positions, kv_valid=None):
@@ -184,27 +211,28 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     rope_positions = positions
     if pad_offset is not None:
         rope_positions = jnp.maximum(positions - pad_offset[:, None], 0)
-    cos, sin = rotary_embedding(rope_positions, cfg.head_dim, cfg.rope_theta, x.dtype)
-
-    def norm_w(w, like):
-        return _norm_w(cfg, w, like)
+    rd = getattr(cfg, "rotary_dim", None) or cfg.head_dim
+    cos, sin = rotary_embedding(rope_positions, rd, cfg.rope_theta, x.dtype)
 
     def one_layer(carry, layer):
         h = carry
         p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
         attn = p["self_attn"]
-        hn = rms_norm(h, norm_w(p["input_layernorm"]["weight"], h), cfg.rms_norm_eps)
-        q, k_new, v_new = _qkv_proj(attn, hn, cos, sin)
+        hn = _chassis_norm(cfg, p["input_layernorm"], h)
+        q, k_new, v_new = _qkv_proj(attn, hn, cos, sin, rotary_dim=rd)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions, kv_valid)
-        h = h + _out_proj(out, attn["o_proj"]["kernel"])
-        hn = rms_norm(h, norm_w(p["post_attention_layernorm"]["weight"], h), cfg.rms_norm_eps)
+        out = _out_proj(out, attn["o_proj"]["kernel"])
+        if "bias" in attn["o_proj"]:
+            out = out + attn["o_proj"]["bias"].astype(out.dtype)
+        h = h + out
+        hn = _chassis_norm(cfg, p["post_attention_layernorm"], h)
         h = h + _mlp(cfg, p["mlp"], hn)
         return h, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
-    x = rms_norm(x, norm_w(model_p["norm"]["weight"], x), cfg.rms_norm_eps)
+    x = _chassis_norm(cfg, model_p["norm"], x)
     h_out = x if return_all else x[:, -1]
     if cfg.tie_word_embeddings:
         logits = h_out @ embed.T.astype(cfg.dtype)

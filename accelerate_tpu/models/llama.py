@@ -132,6 +132,28 @@ def rms_norm(x, weight, eps):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
+def layer_norm(x, weight, bias, eps):
+    """Functional mean-centered norm — the single source of the numerics
+    shared by the LayerNorm module (training) and generation's KV-cache
+    decode plan (parity depends on them staying bit-identical)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * weight + bias).astype(x.dtype)
+
+
+def apply_partial_rope(x, cos, sin, rotary_dim):
+    """RoPE on the leading ``rotary_dim`` dims, pass-through on the rest
+    (StableLM/NeoX-style); shared by LlamaAttention and the decode plan."""
+    d = x.shape[-1]
+    if rotary_dim == d:
+        return apply_rope(x, cos, sin)
+    return jnp.concatenate(
+        [apply_rope(x[..., :rotary_dim], cos, sin), x[..., rotary_dim:]], -1
+    )
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     plus_one: bool = False  # Gemma stores scale as (weight + 1), init zeros
@@ -156,11 +178,7 @@ class LayerNorm(nn.Module):
     def __call__(self, x):
         weight = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
-        xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
-        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
-        return (y * weight + bias).astype(x.dtype)
+        return layer_norm(x, weight, bias, self.eps)
 
 
 def make_norm(cfg: "LlamaConfig", name: str):
@@ -257,12 +275,8 @@ class LlamaAttention(nn.Module):
         v = dense(features=(cfg.num_key_value_heads, d), name="v_proj")(x)
         rd = cfg.rotary_dim
         cos, sin = rotary_embedding(positions, rd, cfg.rope_theta, x.dtype)
-        if rd == d:
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-        else:  # partial rotary (StableLM/NeoX-style): rotate the first rd dims
-            q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin), q[..., rd:]], -1)
-            k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin), k[..., rd:]], -1)
+        q = apply_partial_rope(q, cos, sin, rd)
+        k = apply_partial_rope(k, cos, sin, rd)
         attn_fn = _dispatch_attention(cfg.attention_impl)
         out = attn_fn(q, k, v, causal=True)
         return nn.DenseGeneral(

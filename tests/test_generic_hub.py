@@ -219,6 +219,52 @@ def test_register_arch_spec_user_extension():
     )
 
 
+def test_starcoder2_generates_like_transformers():
+    """Ingested chassis variants are first-class for generation too: the
+    KV-cache decode plan honors layernorm / plain-gelu MLP / biases."""
+    from accelerate_tpu import generate
+
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=None, use_bias=True,
+    )
+    torch.manual_seed(1)
+    hf = transformers.Starcoder2ForCausalLM(hf_cfg)
+    hf.eval()
+    ids = np.random.default_rng(5).integers(0, 96, (1, 6)).astype(np.int64)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, do_sample=False, pad_token_id=0
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(ours, ids.astype(np.int32), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
+
+
+def test_stablelm_generates_like_transformers():
+    """Partial rotary (0.25 head_dim) through the decode plan."""
+    from accelerate_tpu import generate
+
+    hf_cfg = transformers.StableLmConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.25,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = transformers.StableLmForCausalLM(hf_cfg)
+    hf.eval()
+    ids = np.random.default_rng(6).integers(0, 96, (1, 6)).astype(np.int64)
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=5, do_sample=False, pad_token_id=0
+        ).numpy()
+    ours = model_from_pretrained(hf, dtype=jnp.float32)
+    got = generate(ours, ids.astype(np.int32), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(got), want.astype(np.int32))
+
+
 def test_starcoder2_sliding_window_refuses():
     """sliding_window checkpoints compute differently beyond the window —
     the spec must refuse, not load shape-compatibly-but-wrong."""
