@@ -150,7 +150,7 @@ def _build_config(seq: int, oom_level: int, big_hbm: bool):
     return cfg, batch
 
 
-def _measure(seq: int, iters: int, oom_level: int, on_chip: bool):
+def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -173,6 +173,12 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool):
         from accelerate_tpu.models import LlamaConfig
 
         cfg, batch, seq = LlamaConfig.tiny(dtype=jnp.bfloat16), 4, 128
+    if fp8:
+        import dataclasses as _dc
+
+        # Native f8-operand dots in every projection (ops/fp8.py); the
+        # BASELINE.md comparable is the torchao Float8Linear +25% row.
+        cfg = _dc.replace(cfg, fp8=True, fp8_format="HYBRID")
 
     module = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(0)
@@ -238,7 +244,12 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool):
     }
 
 
-def child(oom_level: int) -> int:
+def child(oom_level: int, budget_s: float = 1e9) -> int:
+    t_child0 = time.monotonic()
+
+    def remaining() -> float:
+        return budget_s - (time.monotonic() - t_child0)
+
     import jax
 
     # The axon site-hook calls jax.config.update("jax_platforms", "axon,cpu")
@@ -304,6 +315,70 @@ def child(oom_level: int) -> int:
                     break
         if err8k is not None:
             result["seq8192_error"] = err8k[:500]
+
+    if on_chip and remaining() > 150:
+        # fp8 phase (budget-gated, never fatal): same 1B model, native f8
+        # dots. Streams its own partial so a later kill can't erase it.
+        try:
+            _emit(round(r2k["tok_s"], 1), unit_2k("; fp8 measuring"),
+                  round(r2k["mfu"] / MFU_TARGET, 3), event="fp8_start", **result)
+            rf8 = _measure(2048, 10, oom_level, on_chip, fp8=True)
+            result["tok_s_fp8_2048"] = round(rf8["tok_s"], 1)
+            result["fp8_speedup"] = round(rf8["tok_s"] / r2k["tok_s"], 3)
+            _emit(round(r2k["tok_s"], 1),
+                  unit_2k(extra + f"; fp8: {rf8['tok_s']:.0f} tok/s/chip "
+                          f"({result['fp8_speedup']:.2f}x)"),
+                  round(r2k["mfu"] / MFU_TARGET, 3), event="partial", **result)
+        except Exception as e:  # noqa: BLE001 - recorded, not swallowed
+            result["fp8_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    if on_chip and remaining() > 300:
+        # int8 weight-only decode phase (budget-gated, never fatal): the
+        # generate_bench.py headline, folded in so the driver's own bench
+        # run lands the row even when no interactive session sees the chip.
+        try:
+            import jax.numpy as jnp
+
+            from accelerate_tpu import Model, generate
+            from accelerate_tpu.generation import clear_generation_cache
+            from accelerate_tpu.models import LlamaForCausalLM
+            from accelerate_tpu.utils.quantization import quantize_model_for_decode
+
+            cfg_d, _ = _build_config(2048, 0, False)
+            module_d = LlamaForCausalLM(cfg_d)
+            rng = np.random.default_rng(0)
+            prompt = rng.integers(0, cfg_d.vocab_size, size=(1, 64), dtype=np.int32)
+            dm = Model.from_flax(module_d, jax.random.key(0), prompt)
+            dm.params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), dm.params)
+            new_tokens = 32
+            rows = {}
+            # int8 model is built LAZILY after the bf16 row and the budget
+            # check: quantizing eagerly would hold a second 1B param copy in
+            # HBM through the bf16 compile, and waste the work when the
+            # budget break fires first.
+            variants = (("bf16", lambda: dm),
+                        ("int8", lambda: quantize_model_for_decode(dm)))
+            for name, make in variants:
+                if name == "int8" and remaining() < 120:
+                    break
+                m = make()
+                clear_generation_cache()
+                np.asarray(generate(m, prompt, max_new_tokens=new_tokens))  # compile
+                t0 = time.perf_counter()
+                np.asarray(generate(m, prompt, max_new_tokens=new_tokens))
+                rows[name] = new_tokens / (time.perf_counter() - t0)
+            # Every measured row reaches the stream, budget break or not.
+            if "bf16" in rows:
+                result["decode_tok_s_bf16"] = round(rows["bf16"], 1)
+            if "int8" in rows:
+                result["decode_tok_s_int8"] = round(rows["int8"], 1)
+                result["int8_decode_speedup"] = round(rows["int8"] / rows["bf16"], 3)
+            if rows:
+                msg = "; ".join(f"{k} decode {v:.0f} tok/s" for k, v in rows.items())
+                _emit(round(r2k["tok_s"], 1), unit_2k(extra + "; " + msg),
+                      round(r2k["mfu"] / MFU_TARGET, 3), event="partial", **result)
+        except Exception as e:  # noqa: BLE001 - recorded, not swallowed
+            result["int8_decode_error"] = f"{type(e).__name__}: {e}"[:300]
 
     _emit(round(r2k["tok_s"], 1), unit_2k(extra),
           round(r2k["mfu"] / MFU_TARGET, 3), event="final", **result)
@@ -436,8 +511,15 @@ def supervise() -> int:
             continue
         _emit(0.0, f"HEARTBEAT: probe ok, launching child attempt {attempt}", 0.0,
               event="probe_ok", attempt=attempt, oom_level=oom_level)
-        cmd = [sys.executable, os.path.abspath(__file__), "--child", f"--oom-level={oom_level}"]
-        rc, row, err_tail = _run_child_streaming(cmd, timeout_s=max(60.0, remaining - 45))
+        child_kill = max(60.0, (deadline - time.monotonic()) - 45)
+        # The child's self-budget sits 30 s INSIDE the kill timeout so a
+        # phase that overruns its gate still reaches the final _emit before
+        # the supervisor kills it (a kill would demote a fully-measured run
+        # to an error-annotated partial).
+        child_budget = max(45.0, child_kill - 30.0)
+        cmd = [sys.executable, os.path.abspath(__file__), "--child",
+               f"--oom-level={oom_level}", f"--budget-s={child_budget:.0f}"]
+        rc, row, err_tail = _run_child_streaming(cmd, timeout_s=child_kill)
         if row is not None:
             best_partial = row
         if rc == 0 and row is not None and row.get("event") == "final":
@@ -471,9 +553,10 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", action="store_true")
     parser.add_argument("--oom-level", type=int, default=0)
+    parser.add_argument("--budget-s", type=float, default=1e9)
     args = parser.parse_args()
     if args.child:
-        return child(args.oom_level)
+        return child(args.oom_level, args.budget_s)
     return supervise()
 
 
