@@ -1,0 +1,32 @@
+"""bench.py must never rot: it is the only path perf evidence reaches the
+driver. CPU smoke of the child (tiny config substitution) — asserts the
+final JSON row parses, carries the contract fields, and measures something."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_child_cpu_smoke():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # single CPU device, like a bare bench run
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--child",
+         "--oom-level=0", "--budget-s=240"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
+    assert rows, r.stdout[-2000:]
+    final = rows[-1]
+    assert final["event"] == "final"
+    assert final["metric"] == "llama_fsdp_train_tokens_per_sec_per_chip"
+    assert final["value"] > 0
+    assert {"mfu_2048", "params_b", "device_kind", "platform"} <= final.keys()
+    # Off-chip the fp8/int8/8192 phases must be skipped, not attempted.
+    assert "tok_s_fp8_2048" not in final and "seq8192_error" not in final
