@@ -38,6 +38,7 @@ from .models.llama import (
     layer_norm,
     rms_norm,
     rotary_embedding,
+    scale_residual,
 )
 from .utils.quantization import DecodeQuant, dequantize_decode_kernel
 
@@ -142,6 +143,9 @@ def _embed_tokens(cfg, embed, ids):
     x = jnp.take(embed, ids, axis=0).astype(cfg.dtype)
     if getattr(cfg, "scale_embeddings", False):  # Gemma normalizer
         x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
+    em = getattr(cfg, "embedding_multiplier", 1.0)
+    if em != 1.0:  # Granite scaling
+        x = x * jnp.asarray(em, cfg.dtype)
     return x
 
 
@@ -214,21 +218,26 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     rd = getattr(cfg, "rotary_dim", None) or cfg.head_dim
     cos, sin = rotary_embedding(rope_positions, rd, cfg.rope_theta, x.dtype)
 
+    attn_mult = getattr(cfg, "attention_multiplier", None)
+    res_mult = getattr(cfg, "residual_multiplier", 1.0)
+
     def one_layer(carry, layer):
         h = carry
         p, ck, cv = layer  # layer params, (B,T,Hkv,D) cache slices
         attn = p["self_attn"]
         hn = _chassis_norm(cfg, p["input_layernorm"], h)
         q, k_new, v_new = _qkv_proj(attn, hn, cos, sin, rotary_dim=rd)
+        if attn_mult is not None:  # same q-folding trick as LlamaAttention
+            q = q * jnp.asarray(attn_mult * np.sqrt(cfg.head_dim), q.dtype)
         ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
         out = _attend(q, ck, cv, positions, kv_valid)
         out = _out_proj(out, attn["o_proj"]["kernel"])
         if "bias" in attn["o_proj"]:
             out = out + attn["o_proj"]["bias"].astype(out.dtype)
-        h = h + out
+        h = h + scale_residual(out, res_mult)
         hn = _chassis_norm(cfg, p["post_attention_layernorm"], h)
-        h = h + _mlp(cfg, p["mlp"], hn)
+        h = h + scale_residual(_mlp(cfg, p["mlp"], hn), res_mult)
         return h, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(one_layer, x, (stacked, cache.k, cache.v))
@@ -238,6 +247,9 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         logits = h_out @ embed.T.astype(cfg.dtype)
     else:
         logits = h_out @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    ls = getattr(cfg, "logits_scaling", 1.0)
+    if ls != 1.0:  # Granite: logits / scaling
+        logits = logits / jnp.asarray(ls, logits.dtype)
     return logits.astype(jnp.float32), KVCache(new_k, new_v, start + s)
 
 

@@ -328,7 +328,12 @@ _LLAMA_STYLE_CONFIG = {
     "num_hidden_layers": "num_hidden_layers",
     "num_attention_heads": "num_attention_heads",
     "num_key_value_heads": ("num_key_value_heads", "num_attention_heads", None),
+    "head_dim": ("head_dim", None),
     "max_position_embeddings": ("max_position_embeddings", 4096),
+    # Without this mapping a checkpoint's 1e-6 eps silently becomes the
+    # chassis default 1e-5 — a ~1e-3 systematic logit drift (found by the
+    # Granite parity test; Granite and InternLM2 both use 1e-6).
+    "rms_norm_eps": ("rms_norm_eps", 1e-5),
     "rope_theta": ("rope_theta", 10000.0),
     "tie_word_embeddings": ("tie_word_embeddings", False),
     "hidden_act": ("hidden_act", "silu"),
@@ -435,6 +440,33 @@ register_arch_spec("stablelm", ArchSpec(
     },
     rules=_llama_name_rules(norm_bias=True),
     require={"use_parallel_residual": False, "qk_layernorm": False},
+))
+
+# Granite (IBM): Llama names + four scaling constants (embedding/residual/
+# attention multipliers, logits divisor) — pure chassis-knob config mapping.
+register_arch_spec("granite", ArchSpec(
+    target="llama",
+    config_map={
+        **_LLAMA_STYLE_CONFIG,
+        "embedding_multiplier": ("embedding_multiplier", 1.0),
+        "residual_multiplier": ("residual_multiplier", 1.0),
+        # HF's config default is 1.0 = UNSCALED scores (not llama's
+        # 1/sqrt(d)); a missing key must resolve to that, not to the chassis
+        # None.
+        "attention_multiplier": ("attention_multiplier", 1.0),
+        "logits_scaling": ("logits_scaling", 1.0),
+        "attention_bias": ("attention_bias", False),
+        # HF Granite puts the attention bias on o_proj too.
+        "attention_out_bias": ("attention_bias", False),
+        "mlp_bias": ("mlp_bias", False),
+    },
+    # Bias rules included unconditionally: rules that match no tensor are
+    # inert, so unbiased checkpoints load identically while biased ones get
+    # every tensor claimed.
+    rules=_llama_name_rules(qkv_bias=True, out_bias=True, mlp_bias=True),
+    # The chassis computes plain RoPE only — refuse rope-scaled checkpoints
+    # rather than loading shape-compatibly-but-wrong.
+    require={"rope_scaling": None},
 ))
 
 # InternLM2: exactly the Llama chassis with renamed tensors and a fused,

@@ -57,6 +57,14 @@ class LlamaConfig:
     mlp_bias: bool = False              # biases on the MLP projections
     attention_out_bias: bool = False    # bias on o_proj
     partial_rotary_factor: float = 1.0  # rotate only this fraction of head_dim
+    # Granite-style scaling constants (all 1.0 → plain Llama). The attention
+    # multiplier replaces the 1/sqrt(head_dim) score scale; it is folded into
+    # the q projection output (q *= mult*sqrt(d)) so every attention impl —
+    # the Pallas kernel included — runs unchanged.
+    embedding_multiplier: float = 1.0
+    residual_multiplier: float = 1.0
+    attention_multiplier: Optional[float] = None
+    logits_scaling: float = 1.0
     # Gemma-family quirks (all default off → plain Llama):
     hidden_act: str = "silu"            # "gelu_tanh" for Gemma's GeGLU
     rms_norm_plus_one: bool = False     # norm scale stored as (weight + 1)
@@ -141,6 +149,12 @@ def layer_norm(x, weight, bias, eps):
     var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + eps)
     return (y * weight + bias).astype(x.dtype)
+
+
+def scale_residual(y, mult: float):
+    """Branch residual scaling (Granite residual_multiplier) — single source
+    for the training module and generation's decode plan."""
+    return y if mult == 1.0 else y * jnp.asarray(mult, y.dtype)
 
 
 def apply_partial_rope(x, cos, sin, rotary_dim):
@@ -273,6 +287,11 @@ class LlamaAttention(nn.Module):
         q = dense(features=(cfg.num_attention_heads, d), name="q_proj")(x)
         k = dense(features=(cfg.num_key_value_heads, d), name="k_proj")(x)
         v = dense(features=(cfg.num_key_value_heads, d), name="v_proj")(x)
+        if cfg.attention_multiplier is not None:
+            # Exact: attn computes (q*c*sqrt(d)) . k / sqrt(d) = c * (q.k).
+            q = q * jnp.asarray(
+                cfg.attention_multiplier * np.sqrt(d), q.dtype
+            )
         rd = cfg.rotary_dim
         cos, sin = rotary_embedding(positions, rd, cfg.rope_theta, x.dtype)
         q = apply_partial_rope(q, cos, sin, rd)
@@ -312,13 +331,19 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(
-            make_norm(cfg, "input_layernorm")(x), positions
+        rm = cfg.residual_multiplier
+        h = x + scale_residual(
+            LlamaAttention(cfg, name="self_attn")(
+                make_norm(cfg, "input_layernorm")(x), positions
+            ),
+            rm,
         )
-        out = h + LlamaMLP(cfg, name="mlp")(
-            make_norm(cfg, "post_attention_layernorm")(h)
+        return h + scale_residual(
+            LlamaMLP(cfg, name="mlp")(
+                make_norm(cfg, "post_attention_layernorm")(h)
+            ),
+            rm,
         )
-        return out
 
 
 class _ScannedBlock(nn.Module):
@@ -345,6 +370,8 @@ class LlamaModel(nn.Module):
         )(input_ids)
         if cfg.scale_embeddings:  # Gemma normalizer
             x = x * jnp.asarray(np.sqrt(cfg.hidden_size), cfg.dtype)
+        if cfg.embedding_multiplier != 1.0:  # Granite scaling
+            x = x * jnp.asarray(cfg.embedding_multiplier, cfg.dtype)
         positions = jnp.arange(input_ids.shape[-1])[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, input_ids.shape)
         # Selective remat: with the flash kernel the attention residuals
@@ -397,11 +424,15 @@ class LlamaForCausalLM(nn.Module):
         x = _pin_last_dim_replicated(x)  # see helper: kills FSDP param-sharding
         if cfg.tie_word_embeddings:     # propagation into the loss graph
             embed = self.variables["params"]["model"]["embed_tokens"]["embedding"]
-            return x @ embed.T.astype(cfg.dtype)
-        return nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
-            name="lm_head",
-        )(x)
+            logits = x @ embed.T.astype(cfg.dtype)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+                name="lm_head",
+            )(x)
+        if cfg.logits_scaling != 1.0:  # Granite: logits / scaling
+            logits = logits / jnp.asarray(cfg.logits_scaling, logits.dtype)
+        return logits
 
 
 
