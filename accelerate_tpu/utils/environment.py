@@ -13,6 +13,24 @@ import os
 from typing import Any, Iterator
 
 
+def honor_jax_platforms_env() -> None:
+    """Re-assert an explicit ``JAX_PLATFORMS`` request through jax.config.
+
+    The axon site hook pins ``jax_platforms`` at interpreter start, which
+    outranks the env var — so a CPU smoke run of a benchmark would silently
+    target the (possibly dead, hanging) TPU relay. No-op when the env var is
+    unset or the backend is already initialized."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:  # backend already live: the request can't apply
+        pass
+
+
 def str_to_bool(value: str) -> int:
     """Convert a string to a bool int, accepting y/yes/t/true/on/1 (case-insensitive).
 

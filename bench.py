@@ -252,13 +252,9 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
 
     import jax
 
-    # The axon site-hook calls jax.config.update("jax_platforms", "axon,cpu")
-    # at interpreter start, which outranks the JAX_PLATFORMS env var — so an
-    # explicit env request (e.g. local CPU smoke runs) must be re-asserted
-    # through the same config knob.
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    from accelerate_tpu.utils.environment import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
     platform = jax.devices()[0].platform
     on_chip = platform in ("tpu", "axon")

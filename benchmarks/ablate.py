@@ -15,14 +15,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Honor an explicit JAX_PLATFORMS request (e.g. CPU smoke runs): the axon
-# site hook pins jax_platforms at interpreter start, so the env var alone is
-# ignored — and with the relay down, the default backend hangs forever.
-_env_platforms = os.environ.get("JAX_PLATFORMS")
-if _env_platforms:
-    import jax
+from accelerate_tpu.utils.environment import honor_jax_platforms_env
 
-    jax.config.update("jax_platforms", _env_platforms)
+honor_jax_platforms_env()
 
 
 def measure(seq, iters, *, remat, remat_policy, fused_loss, batch=None, fp8=False):

@@ -62,9 +62,9 @@ def main():
 
     import jax
 
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    from accelerate_tpu.utils.environment import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     print(json.dumps({"row": "start", "platform": jax.devices()[0].platform}), flush=True)
 
     rows = []

@@ -68,9 +68,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    from accelerate_tpu.utils.environment import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
     from accelerate_tpu import Model, dispatch_model, load_checkpoint_and_dispatch
     from accelerate_tpu.generation import generate

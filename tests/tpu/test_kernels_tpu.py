@@ -160,6 +160,28 @@ def test_int8_decode_matmul_parity():
     assert float(jnp.max(jnp.abs(back - w))) < float(jnp.max(dq.scales)) * 0.51
 
 
+def test_fp8_native_matches_qdq_on_chip():
+    """The native f8-operand dot path vs the QDQ formulation, compiled on
+    real hardware — catches an XLA fp8 legalization producing different
+    numerics than the simulation (fwd and both grads)."""
+    from accelerate_tpu.ops.fp8 import fp8_dot_general
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((64, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+    dn = (((1,), (0,)), ((), ()))
+    nat = fp8_dot_general("HYBRID", native=True)
+    ref = fp8_dot_general("HYBRID", native=False)
+    np.testing.assert_allclose(
+        np.asarray(nat(x, w, dn)), np.asarray(ref(x, w, dn)), rtol=2e-3, atol=2e-3
+    )
+    gn = jax.grad(lambda x, w: jnp.sum(nat(x, w, dn) ** 2), argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(ref(x, w, dn) ** 2), argnums=(0, 1))(x, w)
+    for a, b in zip(gn, gr):
+        cos = float(jnp.sum(a * b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+        assert cos > 0.99, cos
+
+
 def test_fp8_lowering_has_f8_types():
     """The fp8 recipe must actually lower with float8 types on chip (QDQ
     converts at minimum; native f8 dots where the recipe enables them)."""
