@@ -4,7 +4,7 @@
 ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 PYTEST = $(ENV) python -m pytest -q
 
-.PHONY: test test_smoke test_core test_models test_parallel test_big_modeling \
+.PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
@@ -67,3 +67,8 @@ test_tpu:
 
 bench:
 	python bench.py
+
+# Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
+# relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8
+# decode rows, 16-min budget). One command = all on-chip evidence.
+chip_evidence: test_tpu bench
