@@ -494,3 +494,51 @@ def test_merge_fsdp_weights_both_formats(tmp_path):
         assert set(flat) == set(want)
         for k in want:
             np.testing.assert_allclose(flat[k], want[k], rtol=1e-6)
+
+
+def test_iteration_continues_past_restored_checkpoint(tmp_path):
+    """load_state from an automatic checkpoint must continue the numbering
+    (iteration = restored + 1) — a fresh process that resumes and then saves
+    must NOT clobber checkpoint_0 (the elastic-resume ordering contract)."""
+    import os
+
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils import ProjectConfiguration, set_seed
+    import flax.linen as nn
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    set_seed(0)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)
+
+    def fresh_acc():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc = Accelerator(project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True,
+        ))
+        module = Net()
+        model = Model.from_flax(module, jax.random.key(0), np.zeros((2, 4), np.float32))
+        model, _ = acc.prepare(model, optax.adam(1e-2))
+        return acc
+
+    acc = fresh_acc()
+    acc.save_state()  # checkpoint_0
+    acc.save_state()  # checkpoint_1
+
+    # Fresh process analog: iteration starts at 0 again.
+    acc2 = fresh_acc()
+    assert acc2.project_configuration.iteration == 0
+    acc2.load_state()  # resolves checkpoint_1
+    assert acc2.project_configuration.iteration == 2
+    acc2.save_state()  # must create checkpoint_2, not overwrite checkpoint_0
+    ckpts = sorted(os.listdir(os.path.join(str(tmp_path), "checkpoints")))
+    assert ckpts == ["checkpoint_0", "checkpoint_1", "checkpoint_2"], ckpts
