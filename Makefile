@@ -5,7 +5,8 @@ ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
-        test_cli test_examples test_checkpointing test_hub test_tpu quality bench
+        test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
+        telemetry-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -67,6 +68,12 @@ test_tpu:
 
 bench:
 	python bench.py
+
+# Observability gate: 20-step toy loop with telemetry on, then assert the
+# per-rank JSONL report is well-formed (schema, recompile counting, summary
+# percentiles). Seconds on the CPU mesh; see docs/usage_guides/observability.md.
+telemetry-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.telemetry_smoke
 
 # Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
 # relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8

@@ -39,6 +39,7 @@ from .data_loader import (  # noqa: E402
     skip_first_batches,
 )
 from .optimizer import AcceleratedOptimizer  # noqa: E402
+from .telemetry import TelemetryRecorder  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .train_state import TrainState  # noqa: E402
 from .launchers import debug_launcher, notebook_launcher  # noqa: E402

@@ -30,6 +30,7 @@ import contextlib
 import functools
 import math
 import os
+import time
 from typing import Any, Callable, Optional, Union
 
 import jax
@@ -73,7 +74,12 @@ from .utils import (
     save_sharded_safetensors,
     set_seed,
 )
-from .utils.dataclasses import DistributedDataParallelKwargs, KwargsHandler, ProfileKwargs
+from .utils.dataclasses import (
+    DistributedDataParallelKwargs,
+    KwargsHandler,
+    ProfileKwargs,
+    TelemetryKwargs,
+)
 
 logger = get_logger(__name__)
 
@@ -184,6 +190,7 @@ class Accelerator:
         self.profile_handler = None
         self.fp8_recipe_handler = None
         self.ddp_handler = None
+        self.telemetry_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -193,6 +200,8 @@ class Accelerator:
                 self.fp8_recipe_handler = handler
             elif isinstance(handler, DistributedDataParallelKwargs):
                 self.ddp_handler = handler
+            elif isinstance(handler, TelemetryKwargs):
+                self.telemetry_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -250,6 +259,14 @@ class Accelerator:
         # Tracking (reference: accelerator.py:3271-3408)
         self.log_with = filter_trackers(log_with, self.project_configuration.logging_dir)
         self.trackers: list[GeneralTracker] = []
+
+        # Step-level telemetry (telemetry.py): off unless a TelemetryKwargs
+        # handler was passed — every hot-path hook is then a None check.
+        self.telemetry = None
+        if self.telemetry_handler is not None and self.telemetry_handler.enabled:
+            from .telemetry import TelemetryRecorder
+
+            self.telemetry = TelemetryRecorder(self, self.telemetry_handler)
 
     # ------------------------------------------------------------------
     # Introspection properties (reference: accelerator.py:640-780)
@@ -928,6 +945,7 @@ class Accelerator:
         if isinstance(data_loader, BaseDataLoader):
             if data_loader not in self._dataloaders:
                 self._dataloaders.append(data_loader)
+            data_loader._telemetry = self.telemetry
             return data_loader
         cfg = self.dataloader_config
         prepared = prepare_data_loader(
@@ -945,6 +963,7 @@ class Accelerator:
             prefetch_size=cfg.prefetch_size,
             dispatch_group_size=cfg.dispatch_group_size,
         )
+        prepared._telemetry = self.telemetry  # host-wait accounting hook
         self._dataloaders.append(prepared)
         return prepared
 
@@ -1056,9 +1075,15 @@ class Accelerator:
             else jnp.asarray(1.0, jnp.float32)
         )
         n_accum = jnp.asarray(float(self.gradient_state.num_steps), jnp.float32)
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
         loss, aux, grads = self._grad_fn_cache[key](
             self._train_state.params, scale, n_accum, *args, **kwargs
         )
+        if tel is not None:
+            if tel.handler.sync_timing:
+                jax.block_until_ready(loss)
+            tel.on_backward(self._grad_fn_cache[key], (args, kwargs), time.perf_counter() - t0)
         if self._optimizers:
             self._optimizers[0].accumulate_grads(grads)
         else:
@@ -1112,12 +1137,17 @@ class Accelerator:
                 _apply, static_argnames=("clip_enabled",), donate_argnums=(0, 1)
             )
         max_norm = jnp.asarray(self._max_grad_norm or 0.0, jnp.float32)
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
         new_state, finite, gnorm = self._apply_jit(
             self._train_state, grads, max_norm, self._max_grad_norm is not None
         )
+        applied = bool(finite)  # host fetch — the barrier telemetry times against
         self._train_state = new_state
         self._last_grad_norm = gnorm
-        return bool(finite)
+        if tel is not None:
+            tel.on_apply_gradients(time.perf_counter() - t0)
+        return applied
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
         """Arm gradient clipping for the next optimizer step and return the
@@ -1317,11 +1347,21 @@ class Accelerator:
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
 
         def step_and_track(state: TrainState, batch):
+            tel = self.telemetry
+            if tel is None:
+                new_state, metrics = jitted(state, batch)
+                # Keep the accelerator's view current: with buffer donation
+                # the previous state's arrays are dead after this call, so
+                # save_state, Model.__call__ and trackers must see the new one.
+                self._train_states[slot] = new_state
+                return new_state, metrics
+            t0 = time.perf_counter()
             new_state, metrics = jitted(state, batch)
-            # Keep the accelerator's view current: with buffer donation the
-            # previous state's arrays are dead after this call, so save_state,
-            # Model.__call__ and trackers must see the new one.
+            if tel.handler.sync_timing:
+                jax.block_until_ready(metrics)
+            wall = time.perf_counter() - t0
             self._train_states[slot] = new_state
+            tel.on_train_step(jitted, batch, wall, metrics=metrics)
             return new_state, metrics
 
         return step_and_track
@@ -1506,10 +1546,16 @@ class Accelerator:
         holder = {"comm_state": comm_state0}
 
         def step_and_track(state: TrainState, batch):
+            tel = self.telemetry
+            t0 = time.perf_counter() if tel is not None else 0.0
             new_state, metrics, holder["comm_state"] = jitted(
                 state, batch, holder["comm_state"]
             )
             self._train_states[slot] = new_state
+            if tel is not None:
+                if tel.handler.sync_timing:
+                    jax.block_until_ready(metrics)
+                tel.on_train_step(jitted, batch, time.perf_counter() - t0, metrics=metrics)
             return new_state, metrics
 
         return step_and_track
@@ -1546,7 +1592,7 @@ class Accelerator:
         except (TypeError, IndexError, KeyError) as e:
             # Un-sliceable payloads keep the reference's forgiving contract,
             # but a real trimming bug must not vanish silently (VERDICT r2).
-            # Strings only: warning_once's lru_cache keys on its args, and a
+            # Strings only: warning_once dedups on its args' reprs, and a
             # live exception instance would defeat dedup AND pin its
             # traceback (and the gathered tensors it references) forever.
             logger.warning_once(
@@ -1753,6 +1799,8 @@ class Accelerator:
 
     def end_training(self):
         self._close_async_checkpointer()
+        if self.telemetry is not None:
+            self.telemetry.close()
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()
@@ -1766,6 +1814,9 @@ class Accelerator:
         from .utils.memory import release_memory
 
         self._close_async_checkpointer()
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
         self._train_state = None
         self._state_shardings = None
         self._grad_shardings = None

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import Optional
 
 import jax
@@ -64,6 +65,15 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = Fal
     if output_dir is None:
         raise ValueError("Provide output_dir or enable automatic_checkpoint_naming.")
     return output_dir
+
+
+def _record_checkpoint_event(accelerator, event: str, t0: float, path: str, **fields) -> None:
+    """Telemetry sidecar: save/restore durations show up in the per-rank
+    JSONL so checkpoint stalls are attributable from the same stream as the
+    step times (telemetry.py)."""
+    tel = getattr(accelerator, "telemetry", None)
+    if tel is not None:
+        tel.record_event(event, seconds=time.perf_counter() - t0, dir=path, **fields)
 
 
 def _save_host_side_state(accelerator, state, output_dir: str) -> None:
@@ -192,6 +202,7 @@ def save_accelerator_state(
     safe_serialization: bool = True,
     block: bool = True,
 ) -> str:
+    t_save0 = time.perf_counter()
     pc = accelerator.project_configuration
     # Any save first drains an in-flight async save: pruning below may rmtree
     # the directory it is persisting into, and a sync save with force=True
@@ -249,6 +260,10 @@ def save_accelerator_state(
         if pc.automatic_checkpoint_naming:
             pc.iteration += 1
         accelerator.wait_for_everyone()
+        _record_checkpoint_event(
+            accelerator, "checkpoint_save", t_save0, output_dir,
+            format="orbax", blocking=bool(block),
+        )
         logger.info(
             f"Saved distributed (orbax) state to {output_dir}"
             + ("" if block else " (async: persisting in background)"),
@@ -314,6 +329,9 @@ def save_accelerator_state(
     if pc.automatic_checkpoint_naming:
         pc.iteration += 1
     accelerator.wait_for_everyone()
+    _record_checkpoint_event(
+        accelerator, "checkpoint_save", t_save0, output_dir, format="safetensors",
+    )
     logger.info(f"Saved accelerator state to {output_dir}", main_process_only=True)
     return output_dir
 
@@ -334,6 +352,7 @@ def _restore_loss_scale(state, input_dir: str):
 
 
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
+    t_load0 = time.perf_counter()
     if hasattr(accelerator, "wait_for_checkpoint"):
         accelerator.wait_for_checkpoint()  # never read a half-persisted save
     input_dir = _checkpoint_dir(accelerator, input_dir, for_load=True)
@@ -347,6 +366,9 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
             loss_scale=_restore_loss_scale(state, input_dir)
         )
         _load_host_side_state(accelerator, input_dir)
+        _record_checkpoint_event(
+            accelerator, "checkpoint_load", t_load0, input_dir, format="orbax",
+        )
         logger.info(
             f"Loaded distributed (orbax) state from {input_dir}", main_process_only=True
         )
@@ -453,6 +475,9 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
 
     _load_host_side_state(accelerator, input_dir)
 
+    _record_checkpoint_event(
+        accelerator, "checkpoint_load", t_load0, input_dir, format="safetensors",
+    )
     logger.info(f"Loaded accelerator state from {input_dir}", main_process_only=True)
     return input_dir
 

@@ -23,6 +23,7 @@ import math
 import queue
 import random as _pyrandom
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
@@ -448,6 +449,10 @@ class BaseDataLoader:
         # batches ready; native collation releases the GIL so assembly truly
         # overlaps the device step. 0 disables.
         self.prefetch_size = kwargs.get("prefetch_size", 2)
+        # Set by Accelerator.prepare_data_loader when telemetry is enabled:
+        # host time blocked waiting on the next batch feeds the recorder's
+        # dataloader-wait accounting (telemetry.py).
+        self._telemetry = None
 
     # -- device side -----------------------------------------------------
 
@@ -499,19 +504,32 @@ class BaseDataLoader:
         # record the epoch whose permutation is actually being consumed.
         sampler = self._stateful_sampler()
         self._sampler_snapshot = sampler.state_dict() if sampler is not None else None
+        tel = self._telemetry
+
+        def _next(it):
+            # Telemetry: the time this call blocks is exactly the host wait
+            # the prefetch thread failed to hide — input starvation.
+            if tel is None:
+                return next(it)
+            t0 = time.perf_counter()
+            try:
+                return next(it)
+            finally:
+                tel.add_data_wait(time.perf_counter() - t0)
+
         try:
             iterator = self._raw_batches()
             if self.prefetch_size and self.prefetch_size > 0:
                 iterator = _PrefetchIterator(iterator, self.prefetch_size)
             try:
-                current = next(iterator)
+                current = _next(iterator)
             except StopIteration:
                 self.batches_yielded = 0
                 self._sampler_snapshot = None
                 return
             while True:
                 try:
-                    nxt = next(iterator)
+                    nxt = _next(iterator)
                 except StopIteration:
                     self.end_of_dataloader = True
                     self.batches_yielded += 1

@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import functools
 import logging
 import os
 
@@ -49,17 +48,36 @@ class MultiProcessAdapter(logging.LoggerAdapter):
         rank = PartialState().process_index
         return f"[RANK {rank}] {msg}" if PartialState().num_processes > 1 else msg, kwargs
 
-    @functools.lru_cache(None)
-    def warning_once(self, *args, **kwargs):
-        self.warning(*args, **kwargs)
+    def warning_once(self, msg, *args, **kwargs):
+        """Warn only the first time this (message, args) combination is seen,
+        process-wide. A module-level seen-key set, NOT lru_cache on the bound
+        method: lru_cache keyed on ``self`` pins every adapter (and whatever
+        its logger graph references) forever, and raises on unhashable args."""
+        key = _warning_once_key(msg, args, kwargs)
+        if key in _WARNED_ONCE:
+            return
+        _WARNED_ONCE.add(key)
+        self.warning(msg, *args, **kwargs)
+
+
+_WARNED_ONCE: set = set()
+
+
+def _warning_once_key(msg, args, kwargs) -> str:
+    try:
+        return repr((str(msg), tuple(map(repr, args)),
+                     tuple(sorted((k, repr(v)) for k, v in kwargs.items()))))
+    except Exception:
+        return str(msg)
 
 
 def get_logger(name: str, log_level: str = None) -> MultiProcessAdapter:
-    """(reference: logging.py:98-133)"""
+    """(reference: logging.py:98-133). The level applies to the NAMED logger
+    only — setting ``logger.root`` here would clobber the root level for
+    every other library in-process."""
     if log_level is None:
         log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
     logger = logging.getLogger(name)
     if log_level is not None:
         logger.setLevel(log_level.upper())
-        logger.root.setLevel(log_level.upper())
     return MultiProcessAdapter(logger, {})

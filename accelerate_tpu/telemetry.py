@@ -1,0 +1,438 @@
+"""Step-level training telemetry (layer L10 — observability).
+
+The profiler (`utils/profiling.py`) answers "where did THIS step's time go"
+on demand; the trackers (`tracking.py`) record whatever scalars the user
+hands them. Neither watches the loop itself, so the regressions that
+actually eat production throughput — silent jit recompiles, input
+starvation, straggler ranks, HBM creep — stay invisible until a bench run
+tanks. :class:`TelemetryRecorder` closes that gap: it rides inside every
+prepared train step and records, per step,
+
+- wall time (dispatch wall by default; exact device wall with
+  ``sync_timing=True``), dataloader-wait time, and samples/s + tokens/s
+  with EMA smoothing;
+- a **recompile watchdog**: the jitted step function's executable-cache
+  size is sampled every call; any growth past the first compile logs a
+  warning carrying the offending batch's shape/dtype digest (the usual
+  culprit — see docs/troubleshooting.md "recompile storms");
+- device-memory gauges (``bytes_in_use`` and a peak-HBM high-water mark)
+  via :func:`~accelerate_tpu.utils.memory.get_device_memory_stats`;
+- cumulative collective-op counters (count + payload bytes) fed by
+  ``utils/operations.py``'s control-plane collectives;
+- a periodic cross-rank straggler probe: every N steps the ranks allgather
+  their last step time and the max/min skew is recorded (and warned about
+  past a threshold).
+
+Records stream to a per-rank JSONL file under ``<project_dir>/telemetry/``
+(crash-safe: line-buffered, one self-contained JSON object per line) and a
+smoothed summary is forwarded into the tracker stack via
+``Accelerator.log()`` on the main process every ``log_every`` steps.
+
+Enable by passing ``TelemetryKwargs`` (utils/dataclasses.py) to
+``Accelerator(kwargs_handlers=[...])``. Off by default; when off, the only
+cost anywhere in the hot path is a ``None`` attribute check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .utils.memory import get_device_memory_stats
+from .utils.operations import collective_counters, gather
+
+logger = get_logger(__name__)
+
+# JSONL record schema, by "event" field:
+#   step            — one prepared-train-step record (the common row)
+#   optimizer_step  — imperative path: backward()-accumulated + apply timing
+#   straggler_probe — cross-rank step-time skew sample
+#   checkpoint_save / checkpoint_load — duration of a (re)store
+#   summary         — final aggregate written by close()
+STEP_RECORD_KEYS = (
+    "event",
+    "step",
+    "time",
+    "wall_s",
+    "data_wait_s",
+    "samples",
+    "samples_per_s",
+    "tokens_per_s",
+    "ema_samples_per_s",
+    "ema_tokens_per_s",
+    "collectives",
+    "hbm_bytes_in_use",
+    "hbm_peak_bytes",
+    "recompiles",
+)
+
+
+def _batch_digest(batch) -> str:
+    """Stable shape/dtype fingerprint of a batch pytree — the watchdog's
+    "what changed" evidence when a recompile fires."""
+    parts = []
+    try:
+        leaves = jax.tree_util.tree_leaves_with_path(batch)
+    except Exception:
+        return f"<undigestable {type(batch).__name__}>"
+    for path, leaf in leaves:
+        name = jax.tree_util.keystr(path) or "leaf"
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None:
+            parts.append(f"{name}:{type(leaf).__name__}")
+        else:
+            parts.append(f"{name}:{dtype}{list(shape)}")
+    return "|".join(parts) or "<empty>"
+
+
+def _batch_counts(batch) -> tuple[Optional[int], Optional[int]]:
+    """(samples, tokens) from a global batch: samples = leading dim of the
+    first array leaf; tokens = B*S of the first rank>=2 leaf (the sequence
+    convention every model in models/ follows)."""
+    samples = tokens = None
+    try:
+        leaves = jax.tree_util.tree_leaves(batch)
+    except Exception:
+        return None, None
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if not shape:
+            continue
+        if samples is None:
+            samples = int(shape[0])
+        if tokens is None and len(shape) >= 2:
+            tokens = int(shape[0]) * int(shape[1])
+        if samples is not None and tokens is not None:
+            break
+    return samples, tokens
+
+
+class TelemetryRecorder:
+    """Per-process training-loop observer. One instance per Accelerator,
+    created when a :class:`~accelerate_tpu.utils.TelemetryKwargs` handler is
+    passed; all hooks no-op through a ``None`` check when absent."""
+
+    def __init__(self, accelerator, handler):
+        self.accelerator = accelerator
+        self.handler = handler
+        self.process_index = accelerator.process_index
+        self.num_processes = accelerator.num_processes
+        base = handler.output_dir or os.path.join(
+            accelerator.project_dir or ".", "telemetry"
+        )
+        self.output_dir = base
+        self.path = os.path.join(base, f"rank_{self.process_index}.jsonl")
+        self._fh = None  # opened lazily: a run that never steps writes nothing
+        self.step = 0
+        self._ema_samples = None
+        self._ema_tokens = None
+        self._peak_hbm: Optional[int] = None
+        self._step_times: list[float] = []
+        self._data_waits: list[float] = []
+        self._pending_data_wait = 0.0
+        self._pending_backward = 0.0
+        self._last_wall: Optional[float] = None
+        # Recompile watchdog state, keyed per watched callable.
+        self._watch: dict[int, dict] = {}
+        self.recompiles = 0
+        self._checkpoint_events = 0
+        # Counters are process-global (utils/operations.py); a new recorder
+        # means a new run's tally.
+        collective_counters.reset()
+        collective_counters.enabled = True
+
+    # -- hot-path hooks ----------------------------------------------------
+
+    def on_train_step(self, step_fn, batch, wall_s: float, metrics=None):
+        """Called by the prepared step wrapper after every step."""
+        self.step += 1
+        self._last_wall = wall_s
+        self._step_times.append(wall_s)
+        data_wait, self._pending_data_wait = self._pending_data_wait, 0.0
+        self._data_waits.append(data_wait)
+        self._watch_recompiles(step_fn, batch)
+        samples, tokens = _batch_counts(batch)
+        samples_per_s = samples / wall_s if samples and wall_s > 0 else None
+        tokens_per_s = tokens / wall_s if tokens and wall_s > 0 else None
+        alpha = self.handler.ema_alpha
+        if samples_per_s is not None:
+            self._ema_samples = (
+                samples_per_s
+                if self._ema_samples is None
+                else alpha * samples_per_s + (1 - alpha) * self._ema_samples
+            )
+        if tokens_per_s is not None:
+            self._ema_tokens = (
+                tokens_per_s
+                if self._ema_tokens is None
+                else alpha * tokens_per_s + (1 - alpha) * self._ema_tokens
+            )
+        record = {
+            "event": "step",
+            "step": self.step,
+            "time": time.time(),
+            "wall_s": wall_s,
+            "data_wait_s": data_wait,
+            "samples": samples,
+            "samples_per_s": samples_per_s,
+            "tokens_per_s": tokens_per_s,
+            "ema_samples_per_s": self._ema_samples,
+            "ema_tokens_per_s": self._ema_tokens,
+            "collectives": collective_counters.snapshot(),
+            "recompiles": self.recompiles,
+        }
+        record.update(self._memory_gauges())
+        if metrics is not None and self.handler.sync_timing:
+            # Only in sync mode: fetching the loss would otherwise force the
+            # very host sync non-blocking timing exists to avoid.
+            loss = metrics.get("loss") if isinstance(metrics, dict) else None
+            if loss is not None:
+                try:
+                    record["loss"] = float(np.asarray(loss))
+                except Exception:
+                    pass
+        self._write(record)
+        every = self.handler.straggler_probe_every
+        if every and self.step % every == 0:
+            self._straggler_probe(wall_s)
+        self._forward_to_trackers(record)
+
+    def on_backward(self, grad_fn, batch, wall_s: float):
+        """Imperative path: accumulate backward wall time; the record is
+        emitted at the apply boundary (on_apply_gradients)."""
+        self._pending_backward += wall_s
+        self._watch_recompiles(grad_fn, batch)
+
+    def on_apply_gradients(self, wall_s: float):
+        self.step += 1
+        backward_s, self._pending_backward = self._pending_backward, 0.0
+        data_wait, self._pending_data_wait = self._pending_data_wait, 0.0
+        total = backward_s + wall_s
+        self._step_times.append(total)
+        self._data_waits.append(data_wait)
+        record = {
+            "event": "optimizer_step",
+            "step": self.step,
+            "time": time.time(),
+            "wall_s": total,
+            "backward_s": backward_s,
+            "apply_s": wall_s,
+            "data_wait_s": data_wait,
+            "collectives": collective_counters.snapshot(),
+            "recompiles": self.recompiles,
+        }
+        record.update(self._memory_gauges())
+        self._write(record)
+        every = self.handler.straggler_probe_every
+        if every and self.step % every == 0:
+            self._straggler_probe(total)
+        self._forward_to_trackers(record)
+
+    def add_data_wait(self, seconds: float):
+        """Fed by the prepared dataloaders: host time blocked waiting for the
+        next batch (collation + read not hidden by prefetch)."""
+        self._pending_data_wait += seconds
+
+    # -- recompile watchdog ------------------------------------------------
+
+    def _watch_recompiles(self, fn, batch):
+        entry = self._watch.setdefault(
+            id(fn), {"cache_size": None, "digests": set(), "layout_recompiled": False}
+        )
+        cache_size_fn = getattr(fn, "_cache_size", None)
+        if callable(cache_size_fn):
+            try:
+                size = int(cache_size_fn())
+            except Exception:
+                size = None
+            if size is not None:
+                prev = entry["cache_size"]
+                entry["cache_size"] = size
+                digest = _batch_digest(batch)
+                new_digest = digest not in entry["digests"]
+                entry["digests"].add(digest)
+                extra = max(0, size - prev) if prev is not None else 0
+                if extra > 0:
+                    self.recompiles += extra
+                    if not new_digest and not entry["layout_recompiled"]:
+                        # The one expected same-shape recompile: donated
+                        # buffers get their layout specialized on the second
+                        # call (bench.py warms up twice for the same reason).
+                        # Counted and recorded, but not warning-worthy.
+                        entry["layout_recompiled"] = True
+                        reason = "donated-buffer layout (expected once)"
+                    else:
+                        reason = (
+                            "batch shape/dtype change" if new_digest
+                            else "unchanged batch shapes — a non-batch argument "
+                                 "is varying"
+                        )
+                        logger.warning(
+                            "telemetry: jitted step recompiled (executable "
+                            "cache %d -> %d, %d recompile(s) total; %s) — "
+                            "offending batch digest: %s. Recompiles retrace "
+                            "and re-lower the whole step; pad to fixed shapes "
+                            "(see docs/troubleshooting.md).",
+                            prev, size, self.recompiles, reason, digest,
+                            main_process_only=False,
+                        )
+                    self._write(
+                        {
+                            "event": "recompile",
+                            "step": self.step,
+                            "time": time.time(),
+                            "recompiles": self.recompiles,
+                            "reason": reason,
+                            "batch_digest": digest,
+                        }
+                    )
+                return
+        # Fallback (no cache-size API): infer from batch-digest novelty.
+        digest = _batch_digest(batch)
+        if digest not in entry["digests"]:
+            first = not entry["digests"]
+            entry["digests"].add(digest)
+            if not first:
+                self.recompiles += 1
+                logger.warning(
+                    "telemetry: batch shape/dtype changed (recompile likely, "
+                    "%d total) — digest: %s",
+                    self.recompiles, digest,
+                    main_process_only=False,
+                )
+                self._write(
+                    {
+                        "event": "recompile",
+                        "step": self.step,
+                        "time": time.time(),
+                        "recompiles": self.recompiles,
+                        "reason": "batch shape/dtype change",
+                        "batch_digest": digest,
+                    }
+                )
+
+    # -- probes & gauges ---------------------------------------------------
+
+    def _memory_gauges(self) -> dict:
+        every = max(1, self.handler.memory_every)
+        if self.step % every != 0:
+            return {"hbm_bytes_in_use": None, "hbm_peak_bytes": self._peak_hbm}
+        stats = get_device_memory_stats()
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", in_use)
+        if peak is not None:
+            peak = int(peak)
+            self._peak_hbm = peak if self._peak_hbm is None else max(self._peak_hbm, peak)
+        return {
+            "hbm_bytes_in_use": int(in_use) if in_use is not None else None,
+            "hbm_peak_bytes": self._peak_hbm,
+        }
+
+    def _straggler_probe(self, wall_s: float):
+        """Allgather the last step time across ranks and record the skew.
+        The probe's own collective must not pollute the counters it reports."""
+        was_enabled, collective_counters.enabled = collective_counters.enabled, False
+        try:
+            times = np.asarray(gather(np.asarray([wall_s], np.float64)), np.float64)
+        except Exception as e:  # a failed probe must never kill training
+            logger.warning(f"telemetry: straggler probe failed: {e}")
+            return
+        finally:
+            collective_counters.enabled = was_enabled
+        t_max, t_min = float(times.max()), float(times.min())
+        mean = float(times.mean()) or 1e-12
+        skew = (t_max - t_min) / mean
+        self._write(
+            {
+                "event": "straggler_probe",
+                "step": self.step,
+                "time": time.time(),
+                "step_time_max_s": t_max,
+                "step_time_min_s": t_min,
+                "skew": skew,
+                "rank_times_s": [float(t) for t in times.ravel()],
+            }
+        )
+        if skew > self.handler.straggler_warn_skew and self.num_processes > 1:
+            slowest = int(np.argmax(times.ravel()))
+            logger.warning(
+                "telemetry: straggler skew %.1f%% at step %d (max %.4fs rank %d, "
+                "min %.4fs) — one rank is consistently behind; check its input "
+                "pipeline and host load (docs/troubleshooting.md).",
+                100 * skew, self.step, t_max, slowest, t_min,
+            )
+
+    def record_event(self, event: str, **fields):
+        """Out-of-band durations (checkpoint save/load, user phases)."""
+        if event in ("checkpoint_save", "checkpoint_load"):
+            self._checkpoint_events += 1
+        record = {"event": event, "step": self.step, "time": time.time()}
+        record.update(fields)
+        self._write(record)
+
+    # -- output ------------------------------------------------------------
+
+    def _write(self, record: dict):
+        if self._fh is None:
+            os.makedirs(self.output_dir, exist_ok=True)
+            # Line-buffered: each record is durable on its newline, so a
+            # preempted run keeps every completed step's row.
+            self._fh = open(self.path, "a", buffering=1)
+        self._fh.write(json.dumps(record) + "\n")
+
+    def _forward_to_trackers(self, record: dict):
+        every = self.handler.log_every
+        if not every or self.step % every != 0:
+            return
+        acc = self.accelerator
+        if not getattr(acc, "trackers", None):
+            return
+        values = {
+            "telemetry/step_time_s": record.get("wall_s"),
+            "telemetry/data_wait_s": record.get("data_wait_s"),
+            "telemetry/recompiles": record.get("recompiles"),
+        }
+        if record.get("ema_samples_per_s") is not None:
+            values["telemetry/samples_per_s"] = record["ema_samples_per_s"]
+        if record.get("ema_tokens_per_s") is not None:
+            values["telemetry/tokens_per_s"] = record["ema_tokens_per_s"]
+        if record.get("hbm_peak_bytes") is not None:
+            values["telemetry/hbm_peak_bytes"] = record["hbm_peak_bytes"]
+        acc.log({k: v for k, v in values.items() if v is not None}, step=self.step)
+
+    def summary(self) -> dict:
+        """Aggregate of everything recorded so far — embedded in bench
+        output and written as the final JSONL record by close()."""
+        times = np.asarray(self._step_times, np.float64)
+        waits = np.asarray(self._data_waits, np.float64)
+        out = {
+            "steps": int(times.size),
+            "recompiles": self.recompiles,
+            "peak_hbm_bytes": self._peak_hbm,
+            "collectives": collective_counters.snapshot(),
+            "checkpoint_events": self._checkpoint_events,
+        }
+        if times.size:
+            out.update(
+                step_time_mean_s=float(times.mean()),
+                step_time_p50_s=float(np.percentile(times, 50)),
+                step_time_p90_s=float(np.percentile(times, 90)),
+                data_wait_mean_s=float(waits.mean()) if waits.size else 0.0,
+                ema_samples_per_s=self._ema_samples,
+                ema_tokens_per_s=self._ema_tokens,
+            )
+        return out
+
+    def close(self):
+        if self._fh is not None:
+            self._write({"event": "summary", "time": time.time(), **self.summary()})
+            self._fh.close()
+            self._fh = None
+        collective_counters.enabled = False

@@ -90,7 +90,9 @@ class JSONTracker(GeneralTracker):
         self.run_name = run_name
         os.makedirs(logging_dir, exist_ok=True)
         self.path = os.path.join(logging_dir, f"{run_name}.metrics.jsonl")
-        self._fh = open(self.path, "a")
+        # Line-buffered + per-record flush: a crashed or preempted run keeps
+        # every record already appended.
+        self._fh = open(self.path, "a", buffering=1)
 
     @property
     def tracker(self):

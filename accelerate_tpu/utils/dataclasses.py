@@ -265,6 +265,35 @@ class ProfileKwargs(KwargsHandler):
 
 
 @dataclass
+class TelemetryKwargs(KwargsHandler):
+    """Step-level telemetry config (telemetry.py). Passing this handler to
+    ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it
+    no recorder exists and every hook is a single ``None`` check.
+
+    - ``sync_timing``: block on the step's metrics before stopping the step
+      timer. Exact per-step device wall time, but it defeats async dispatch —
+      leave False (dispatch wall; converges to the true step time once the
+      device queue applies backpressure) for production loops.
+    - ``log_every``: forward the smoothed summary into the tracker stack via
+      ``Accelerator.log()`` every N steps (main process; 0 disables).
+    - ``straggler_probe_every``: allgather step times across ranks every N
+      steps and record max/min skew (0 disables).
+    - ``memory_every``: sample device-memory stats every N steps (some
+      backends make ``memory_stats()`` a sync point).
+    - ``output_dir``: JSONL destination; default ``<project_dir>/telemetry``.
+    """
+
+    enabled: bool = True
+    sync_timing: bool = False
+    log_every: int = 10
+    straggler_probe_every: int = 50
+    straggler_warn_skew: float = 0.2
+    ema_alpha: float = 0.1
+    memory_every: int = 1
+    output_dir: Optional[str] = None
+
+
+@dataclass
 class JitConfig(KwargsHandler):
     """Compilation policy — the role of the reference's TorchDynamoPlugin
     (reference: utils/dataclasses.py:1031-1118). XLA jit is always on; these

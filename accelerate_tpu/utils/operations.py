@@ -40,6 +40,45 @@ class DistributedOperationException(Exception):
     ranks (reference: utils/operations.py:361-380)."""
 
 
+class _CollectiveCounters:
+    """Process-wide count + payload-bytes tally of the control-plane
+    collectives in this module, consumed by the telemetry subsystem
+    (telemetry.py). Disabled (a single bool check per call) unless a
+    TelemetryRecorder is live."""
+
+    __slots__ = ("enabled", "counts", "bytes")
+
+    def __init__(self):
+        self.enabled = False
+        self.counts: dict = {}
+        self.bytes: dict = {}
+
+    def record(self, op: str, tensor) -> None:
+        if not self.enabled:
+            return
+        nbytes = 0
+        try:
+            for leaf in jax.tree_util.tree_leaves(tensor):
+                nbytes += int(getattr(leaf, "nbytes", 0) or 0)
+        except Exception:
+            pass
+        self.counts[op] = self.counts.get(op, 0) + 1
+        self.bytes[op] = self.bytes.get(op, 0) + nbytes
+
+    def snapshot(self) -> dict:
+        return {
+            op: {"count": n, "bytes": self.bytes.get(op, 0)}
+            for op, n in sorted(self.counts.items())
+        }
+
+    def reset(self) -> None:
+        self.counts.clear()
+        self.bytes.clear()
+
+
+collective_counters = _CollectiveCounters()
+
+
 # ---------------------------------------------------------------------------
 # Nested-structure plumbing (pytrees make most of the reference's manual
 # recursion free, but we keep the honest-recursion versions so Mapping
@@ -270,6 +309,7 @@ def gather(tensor):
     - Per-process local numpy/host data: tiled all-gather across processes
       (reference semantics of ``_gpu_gather``, utils/operations.py:307-358).
     """
+    collective_counters.record("gather", tensor)
     if _world() == 1:
         def _maybe_devget(t):
             return np.asarray(t)
@@ -318,6 +358,7 @@ def gather_object(object: Any):
 def broadcast(tensor, from_process: int = 0):
     """Broadcast a (nested) tensor from one process to all
     (reference: utils/operations.py:474-494)."""
+    collective_counters.record("broadcast", tensor)
     if _world() == 1:
         return tensor
     from jax.experimental import multihost_utils
@@ -415,6 +456,7 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
     Per-process host values are summed across ranks; an already-global
     jax.Array (a jit output) is by definition identical on every rank, so the
     cross-process reduce is an identity on it — only ``scale`` applies."""
+    collective_counters.record("reduce", tensor)
 
     def _reduce_one(t):
         if is_global_array(t) and _world() > 1:
@@ -438,6 +480,7 @@ def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bo
     """Pad every process's tensor along ``dim`` to the max size across
     processes so a subsequent ``gather`` is legal
     (reference: utils/operations.py:790-840)."""
+    collective_counters.record("pad_across_processes", tensor)
 
     def _pad_one(t):
         if is_global_array(t) and _world() > 1:

@@ -151,6 +151,8 @@ def _build_config(seq: int, oom_level: int, big_hbm: bool):
 
 
 def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = False):
+    import tempfile
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -158,7 +160,11 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
     from accelerate_tpu import Accelerator, Model
     from accelerate_tpu.models import LlamaForCausalLM, cross_entropy_loss
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
-    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+    from accelerate_tpu.utils import (
+        FullyShardedDataParallelPlugin,
+        TelemetryKwargs,
+        set_seed,
+    )
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
@@ -184,7 +190,22 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1), dtype=np.int32)
 
-    acc = Accelerator(mixed_precision="bf16", fsdp_plugin=FullyShardedDataParallelPlugin())
+    # Telemetry rides along in non-blocking mode (sync_timing=False): per-step
+    # dispatch walls converge to the true step time once the device queue
+    # backs up, and the async pipeline the bench measures stays untouched.
+    # The summary (mean/p50/p90 step time, recompiles, peak HBM) lands in the
+    # emitted rows so future rounds get a comparable perf trajectory.
+    acc = Accelerator(
+        mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(),
+        kwargs_handlers=[
+            TelemetryKwargs(
+                straggler_probe_every=0,
+                log_every=0,
+                output_dir=tempfile.mkdtemp(prefix="bench_telemetry_"),
+            )
+        ],
+    )
     model = Model.from_flax(module, jax.random.key(0), ids[:, :-1])
     # 16GB chips cannot hold 1B fp32 masters + fp32 Adam moments + grads;
     # use the bf16-everything TPU recipe there and fp32 masters when HBM allows.
@@ -223,6 +244,8 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
     dt = (time.perf_counter() - t0) / iters
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
+    telemetry = acc.telemetry.summary() if acc.telemetry is not None else None
+
     devices = jax.devices()
     n_devices = len(devices)
     kind = getattr(devices[0], "device_kind", "") or devices[0].platform
@@ -241,6 +264,7 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
         "device_kind": kind,
         "precision": precision,
         "remat_policy": cfg.remat_policy,
+        "telemetry": telemetry,
     }
 
 
@@ -275,6 +299,23 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
         "device_kind": r2k["device_kind"],
         "platform": platform,
     }
+    if r2k.get("telemetry"):
+        # Step-time distribution + recompile/HBM accounting from the
+        # telemetry subsystem (telemetry.py) — BENCH_*.json carries it so
+        # future rounds can compare trajectories, not just the headline mean.
+        t = r2k["telemetry"]
+        result["telemetry"] = {
+            k: t.get(k)
+            for k in (
+                "steps",
+                "step_time_mean_s",
+                "step_time_p50_s",
+                "step_time_p90_s",
+                "data_wait_mean_s",
+                "recompiles",
+                "peak_hbm_bytes",
+            )
+        }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).
     _emit(round(r2k["tok_s"], 1), unit_2k("; seq-8192 pending"),

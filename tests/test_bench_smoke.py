@@ -30,3 +30,10 @@ def test_bench_child_cpu_smoke():
     assert {"mfu_2048", "params_b", "device_kind", "platform"} <= final.keys()
     # Off-chip the fp8/int8/8192 phases must be skipped, not attempted.
     assert "tok_s_fp8_2048" not in final and "seq8192_error" not in final
+    # Telemetry summary rides in every bench row (step-time distribution,
+    # recompiles, peak HBM) so rounds stay comparable.
+    tel = final.get("telemetry")
+    assert tel, f"telemetry summary missing from final row: {final}"
+    assert tel["steps"] > 0
+    assert tel["step_time_mean_s"] > 0
+    assert "recompiles" in tel and "peak_hbm_bytes" in tel

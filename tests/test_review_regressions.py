@@ -202,14 +202,14 @@ def test_autocast_warns_once_and_is_noop():
     import logging as _logging
 
     from accelerate_tpu import Accelerator
-    from accelerate_tpu.logging import MultiProcessAdapter
+    from accelerate_tpu.logging import _WARNED_ONCE
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
     acc = Accelerator()
-    # warning_once caches per-process: clear so earlier tests can't have
+    # warning_once dedups per-process: clear so earlier tests can't have
     # consumed this warning already.
-    MultiProcessAdapter.warning_once.cache_clear()
+    _WARNED_ONCE.clear()
     logger = _logging.getLogger("accelerate_tpu.accelerator")
     records = []
     handler = _logging.Handler()
