@@ -6,7 +6,7 @@ PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
-        telemetry-smoke
+        telemetry-smoke warmup-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -74,6 +74,13 @@ bench:
 # percentiles). Seconds on the CPU mesh; see docs/usage_guides/observability.md.
 telemetry-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.telemetry_smoke
+
+# Compile-manager gate: ragged toy loop (8 raw shapes) under pow2 bucketing
+# compiles <= 4 executables; a restart warms every shapes-manifest signature
+# before step 0 and telemetry reports 0 recompiles afterwards. See
+# docs/usage_guides/performance.md "Taming recompiles".
+warmup-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.warmup_smoke
 
 # Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
 # relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8

@@ -40,6 +40,7 @@ from .data_loader import (  # noqa: E402
 )
 from .optimizer import AcceleratedOptimizer  # noqa: E402
 from .telemetry import TelemetryRecorder  # noqa: E402
+from .compile_manager import CompileManager, ShapesManifest  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .train_state import TrainState  # noqa: E402
 from .launchers import debug_launcher, notebook_launcher  # noqa: E402

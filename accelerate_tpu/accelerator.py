@@ -75,6 +75,7 @@ from .utils import (
     set_seed,
 )
 from .utils.dataclasses import (
+    CompileKwargs,
     DistributedDataParallelKwargs,
     KwargsHandler,
     ProfileKwargs,
@@ -191,6 +192,7 @@ class Accelerator:
         self.fp8_recipe_handler = None
         self.ddp_handler = None
         self.telemetry_handler = None
+        self.compile_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -202,6 +204,8 @@ class Accelerator:
                 self.ddp_handler = handler
             elif isinstance(handler, TelemetryKwargs):
                 self.telemetry_handler = handler
+            elif isinstance(handler, CompileKwargs):
+                self.compile_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -217,7 +221,11 @@ class Accelerator:
         )
         self.jit_config = jit_config or JitConfig.from_env()
         if self.jit_config.persistent_cache_dir:
-            jax.config.update("jax_compilation_cache_dir", self.jit_config.persistent_cache_dir)
+            # Validated (created; warning_once when unusable) instead of the
+            # old bare passthrough of a possibly-bad path to jax.config.
+            from .compile_manager import configure_persistent_cache
+
+            self.jit_config.persistent_cache_dir = configure_persistent_cache(self.jit_config)
 
         self._mp_policy = MixedPrecisionPolicy.from_mixed_precision(self.state.mixed_precision)
         self.device_placement = device_placement
@@ -267,6 +275,16 @@ class Accelerator:
             from .telemetry import TelemetryRecorder
 
             self.telemetry = TelemetryRecorder(self, self.telemetry_handler)
+
+        # Compile manager (compile_manager.py): shape bucketing, AOT warmup
+        # and persistent-cache control. Same contract as telemetry — off
+        # unless a CompileKwargs handler was passed, then every hook site is
+        # a None check.
+        self.compile_manager = None
+        if self.compile_handler is not None and self.compile_handler.enabled:
+            from .compile_manager import CompileManager
+
+            self.compile_manager = CompileManager(self, self.compile_handler)
 
     # ------------------------------------------------------------------
     # Introspection properties (reference: accelerator.py:640-780)
@@ -740,19 +758,29 @@ class Accelerator:
         else:
             opt_state, opt_shardings = (), ()
             grad_shardings, opt_offload = None, None
+        rep = replicated(mesh)
         extra = model.extra_state
         extra_shardings = jax.tree.map(lambda _: replicated(mesh), extra) if extra else None
+        # Every leaf is COMMITTED from the start (step/loss_scale/extra too,
+        # not just params/opt_state): an uncommitted scalar in the initial
+        # state gives the first step call different input avals than every
+        # later call (whose state is the step's committed output), costing
+        # one extra executable per step fn — the "layout (expected once)"
+        # recompile the telemetry watchdog used to tolerate.
         state = TrainState(
-            step=jnp.asarray(0, jnp.int32),
+            step=jax.device_put(jnp.asarray(0, jnp.int32), rep),
             params=params,
             opt_state=opt_state,
-            extra_state=extra,
+            extra_state=jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), extra)
+            if extra
+            else extra,
             accum_grads=None,
-            loss_scale=loss_scale,
+            loss_scale=jax.tree.map(lambda x: jax.device_put(x, rep), loss_scale)
+            if loss_scale is not None
+            else None,
             apply_fn=model.apply_fn,
             tx=tx,
         )
-        rep = replicated(mesh)
         state_shardings = TrainState(
             step=rep,
             params=param_shardings,
@@ -946,6 +974,7 @@ class Accelerator:
             if data_loader not in self._dataloaders:
                 self._dataloaders.append(data_loader)
             data_loader._telemetry = self.telemetry
+            data_loader._compile_manager = self.compile_manager
             return data_loader
         cfg = self.dataloader_config
         prepared = prepare_data_loader(
@@ -964,6 +993,7 @@ class Accelerator:
             dispatch_group_size=cfg.dispatch_group_size,
         )
         prepared._telemetry = self.telemetry  # host-wait accounting hook
+        prepared._compile_manager = self.compile_manager  # bucket padding hook
         self._dataloaders.append(prepared)
         return prepared
 
@@ -1345,8 +1375,15 @@ class Accelerator:
                 return new_state, {"loss": loss, "grad_norm": gnorm}
 
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+        if self.compile_manager is not None:
+            # Registers the underlying jitted step for executable counting
+            # and AOT-warms every known manifest signature before step 0.
+            self.compile_manager.register_step(jitted, slot=slot, label="train_step")
 
         def step_and_track(state: TrainState, batch):
+            cm = self.compile_manager
+            if cm is not None:
+                cm.observe(batch)  # new signatures land in the shapes manifest
             tel = self.telemetry
             if tel is None:
                 new_state, metrics = jitted(state, batch)
@@ -1365,6 +1402,19 @@ class Accelerator:
             return new_state, metrics
 
         return step_and_track
+
+    def warmup_compile(self) -> Optional[dict]:
+        """Compile every shapes-manifest signature against the prepared train
+        steps NOW, off the training clock (compile_manager.py). Runs
+        automatically inside :meth:`prepare_train_step` when a
+        :class:`~accelerate_tpu.utils.CompileKwargs` handler enables warmup;
+        call it again manually after the manifest grows (e.g. a fresh eval
+        shape appeared). Idempotent — already-warmed signatures are skipped.
+        Returns the cumulative warmup stats, or ``None`` when the compile
+        manager is off."""
+        if self.compile_manager is None:
+            return None
+        return self.compile_manager.warmup()
 
     def _comm_hook_step(
         self,
@@ -1544,8 +1594,17 @@ class Accelerator:
         # params-sized fp32 — updating them in place matters.
         jitted = jax.jit(hook_step, donate_argnums=(0, 2) if donate else ())
         holder = {"comm_state": comm_state0}
+        if self.compile_manager is not None:
+            # warmable=False: the hook step threads comm_state as a third
+            # argument, which the manifest-driven warmup cannot synthesize.
+            self.compile_manager.register_step(
+                jitted, slot=slot, label="comm_hook_step", warmable=False
+            )
 
         def step_and_track(state: TrainState, batch):
+            cm = self.compile_manager
+            if cm is not None:
+                cm.observe(batch)
             tel = self.telemetry
             t0 = time.perf_counter() if tel is not None else 0.0
             new_state, metrics, holder["comm_state"] = jitted(
@@ -1800,7 +1859,9 @@ class Accelerator:
     def end_training(self):
         self._close_async_checkpointer()
         if self.telemetry is not None:
-            self.telemetry.close()
+            self.telemetry.close()  # summary still sees the compile manager
+        if self.compile_manager is not None:
+            self.compile_manager.close()  # persistent-cache LRU prune
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()
@@ -1817,6 +1878,9 @@ class Accelerator:
         if self.telemetry is not None:
             self.telemetry.close()
             self.telemetry = None
+        if self.compile_manager is not None:
+            self.compile_manager.close()
+            self.compile_manager = None
         self._train_state = None
         self._state_shardings = None
         self._grad_shardings = None

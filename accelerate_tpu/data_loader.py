@@ -453,6 +453,12 @@ class BaseDataLoader:
         # host time blocked waiting on the next batch feeds the recorder's
         # dataloader-wait accounting (telemetry.py).
         self._telemetry = None
+        # Set by Accelerator.prepare_data_loader when a CompileKwargs handler
+        # enables the compile manager: host batches are padded to bucket
+        # shapes at the device boundary (compile_manager.bucket_pad), so a
+        # ragged stream compiles at most len(buckets) executables. None =
+        # ship true shapes, byte-identical to the unmanaged path.
+        self._compile_manager = None
 
     # -- device side -----------------------------------------------------
 
@@ -468,12 +474,31 @@ class BaseDataLoader:
             )
         return jax.sharding.NamedSharding(mesh, spec)
 
+    def _pad_hint(self) -> Optional[int]:
+        """This process's full local batch size — the bucket the ragged
+        final ``drop_last=False`` batch pads up to. With ``even_batches=True``
+        (the default) the samplers already cycle real samples so the final
+        map-style batch arrives full and padding is a no-op; the hint matters
+        for ``even_batches=False``, iterable datasets, and dispatch mode,
+        whose true-shape tails each cost a one-off recompile every epoch."""
+        total = self.total_batch_size
+        if not total:
+            return None
+        return max(1, total // max(1, PartialState().num_processes))
+
     def _device_put_batch(self, batch):
         """Host numpy shard → one global jax.Array over the mesh. The fused
         train step splits microbatches for gradient accumulation *inside* jit,
-        so every loader always emits plain ``(B, ...)`` global batches."""
+        so every loader always emits plain ``(B, ...)`` global batches.
+
+        When the compile manager is on, the batch is padded to bucket shapes
+        HERE — the device boundary — so everything downstream (device_put,
+        telemetry digests, the jitted step) only ever sees bucket shapes."""
         if not self.device_placement:
             return batch
+        cm = self._compile_manager
+        if cm is not None:
+            batch = cm.bucket_pad(_to_numpy_tree(batch), batch_size_hint=self._pad_hint())
 
         def _put(arr):
             arr = np.asarray(arr)
@@ -657,6 +682,11 @@ class IterableDataLoaderShard(BaseDataLoader):
     def __init__(self, dataset_shard: IterableDatasetShard, batch_size: int, **kwargs):
         super().__init__(dataset_shard, batch_sampler=None, **kwargs)
         self.batch_size = batch_size
+
+    def _pad_hint(self) -> Optional[int]:
+        # No batch sampler, so total_batch_size is None — the per-process
+        # batch size is the bucket the ragged tail pads to.
+        return self.batch_size
 
     def _raw_batches(self):
         element_it = iter(self.dataset)

@@ -157,7 +157,7 @@ class TelemetryRecorder:
         self._step_times.append(wall_s)
         data_wait, self._pending_data_wait = self._pending_data_wait, 0.0
         self._data_waits.append(data_wait)
-        self._watch_recompiles(step_fn, batch)
+        self._watch_recompiles(step_fn, batch, manifest=True)
         samples, tokens = _batch_counts(batch)
         samples_per_s = samples / wall_s if samples and wall_s > 0 else None
         tokens_per_s = tokens / wall_s if tokens and wall_s > 0 else None
@@ -242,7 +242,20 @@ class TelemetryRecorder:
 
     # -- recompile watchdog ------------------------------------------------
 
-    def _watch_recompiles(self, fn, batch):
+    def _record_manifest_signature(self, batch, digest: str):
+        """Watchdog → shapes-manifest bridge: every NEW step-batch signature
+        is persisted (one JSONL line) so the compile manager's AOT warmup can
+        consume it across runs — including runs where only telemetry was on
+        (compile_manager.record_watchdog_signature writes a standalone
+        manifest under the project dir in that case)."""
+        try:
+            from .compile_manager import record_watchdog_signature
+
+            record_watchdog_signature(self.accelerator, batch, digest)
+        except Exception as e:  # a bridge failure must never kill training
+            logger.warning_once(f"telemetry: shapes-manifest bridge failed: {e}")
+
+    def _watch_recompiles(self, fn, batch, manifest: bool = False):
         entry = self._watch.setdefault(
             id(fn), {"cache_size": None, "digests": set(), "layout_recompiled": False}
         )
@@ -258,6 +271,8 @@ class TelemetryRecorder:
                 digest = _batch_digest(batch)
                 new_digest = digest not in entry["digests"]
                 entry["digests"].add(digest)
+                if new_digest and manifest:
+                    self._record_manifest_signature(batch, digest)
                 extra = max(0, size - prev) if prev is not None else 0
                 if extra > 0:
                     self.recompiles += extra
@@ -299,6 +314,8 @@ class TelemetryRecorder:
         if digest not in entry["digests"]:
             first = not entry["digests"]
             entry["digests"].add(digest)
+            if manifest:
+                self._record_manifest_signature(batch, digest)
             if not first:
                 self.recompiles += 1
                 logger.warning(
@@ -419,6 +436,16 @@ class TelemetryRecorder:
             "collectives": collective_counters.snapshot(),
             "checkpoint_events": self._checkpoint_events,
         }
+        # Executable census: total dispatch-cache size across the watched
+        # jitted fns — the number shape bucketing caps at len(buckets).
+        sizes = [e["cache_size"] for e in self._watch.values() if e["cache_size"]]
+        if sizes:
+            out["executables"] = int(sum(sizes))
+        cm = getattr(self.accelerator, "compile_manager", None)
+        if cm is not None:
+            # Bucket/warmup/persistent-cache stats (hit-miss counters live
+            # under "persistent_cache") from the compile manager.
+            out["compile"] = cm.summary()
         if times.size:
             out.update(
                 step_time_mean_s=float(times.mean()),

@@ -1,0 +1,145 @@
+"""`make warmup-smoke`: the compile-manager acceptance loop on the virtual
+CPU mesh.
+
+Phase 1 (cold): a toy loop over ragged batches — 8 distinct raw sequence
+lengths — under ``CompileKwargs(buckets="pow2")``. Asserts the bucket ladder
+caps the executable count at 4 (vs 8 unbucketed) and that every bucket
+signature landed in the shapes manifest.
+
+Phase 2 (restart): a fresh Accelerator over the same project dir. The
+manifest-driven warmup compiles every signature inside
+``prepare_train_step`` — before step 0 — and the full ragged epoch then
+replays with ZERO recompiles reported by telemetry.
+"""
+
+import itertools
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+N_ITEMS, DIM, BATCH = 128, 4, 16
+RAGGED_LENGTHS = [5, 7, 9, 12, 17, 24, 33, 47]  # pow2 buckets: 8/16/32/64
+EXPECTED_BUCKETS = 4
+
+
+def _loop(project_dir):
+    import jax
+    import optax
+    import flax.linen as nn
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import CompileKwargs, TelemetryKwargs, set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    set_seed(0)
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(N_ITEMS, 64, DIM)).astype(np.float32)
+    ys = rng.normal(size=(N_ITEMS, 64, 1)).astype(np.float32)
+
+    class Dataset:
+        def __len__(self):
+            return N_ITEMS
+
+        def __getitem__(self, i):
+            return {"x": xs[i], "y": ys[i]}
+
+    counter = itertools.count()
+
+    def ragged_collate(samples):
+        s = RAGGED_LENGTHS[next(counter) % len(RAGGED_LENGTHS)]
+        return {
+            "x": np.stack([it["x"][:s] for it in samples]),
+            "y": np.stack([it["y"][:s] for it in samples]),
+        }
+
+    class Spec:
+        dataset = Dataset()
+        batch_size = BATCH
+        sampler = None
+        drop_last = False
+        collate_fn = staticmethod(ragged_collate)
+
+    acc = Accelerator(
+        project_dir=project_dir,
+        kwargs_handlers=[
+            CompileKwargs(buckets="pow2"),
+            TelemetryKwargs(sync_timing=True, straggler_probe_every=0, log_every=0),
+        ],
+    )
+    module = nn.Dense(1)
+    model = Model.from_flax(module, jax.random.key(0), xs[:1, :8])
+    model, _, dl = acc.prepare(model, optax.sgd(0.01), Spec())
+
+    def loss_fn(params, batch):
+        pred = module.apply({"params": params}, batch["x"])
+        return ((pred - batch["y"]) ** 2).mean()
+
+    step = acc.prepare_train_step(loss_fn)
+    warmup = dict(acc.compile_manager.warmup_stats)
+    state = acc.train_state
+    steps = 0
+    for batch in dl:
+        state, _ = step(state, batch)
+        steps += 1
+    summary = {
+        "steps": steps,
+        "executables": acc.compile_manager.executable_count(),
+        "recompiles": acc.telemetry.recompiles,
+        "manifest": len(acc.compile_manager.manifest),
+        "warmup": warmup,
+    }
+    acc.end_training()
+    return summary
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="warmup_smoke_")
+
+    cold = _loop(tmp)
+    assert cold["steps"] == N_ITEMS // BATCH, cold
+    assert cold["executables"] <= EXPECTED_BUCKETS, (
+        f"bucketing failed to cap executables: {cold}"
+    )
+    assert cold["manifest"] == EXPECTED_BUCKETS, (
+        f"expected one manifest signature per bucket: {cold}"
+    )
+    assert cold["warmup"]["signatures_compiled"] == 0, cold  # nothing to warm yet
+    manifest_path = os.path.join(tmp, "compile_cache", "shapes_manifest.jsonl")
+    assert os.path.exists(manifest_path), "shapes manifest was not written"
+    with open(manifest_path) as fh:
+        for i, line in enumerate(fh):
+            try:
+                json.loads(line)
+            except ValueError as e:
+                raise AssertionError(f"manifest line {i} is not valid JSON: {line!r}") from e
+
+    warm = _loop(tmp)
+    assert warm["warmup"]["signatures_compiled"] == EXPECTED_BUCKETS, (
+        f"warmup did not compile every manifest signature: {warm}"
+    )
+    assert warm["warmup"]["seconds"] > 0, warm
+    assert warm["recompiles"] == 0, (
+        f"telemetry reported recompiles AFTER warmup: {warm}"
+    )
+    assert warm["executables"] <= EXPECTED_BUCKETS, warm
+
+    print(
+        "WARMUP SMOKE OK — "
+        f"{len(RAGGED_LENGTHS)} raw shapes -> {cold['executables']} executables "
+        f"cold ({cold['recompiles']} recompiles); restart warmed "
+        f"{warm['warmup']['signatures_compiled']} signature(s) in "
+        f"{warm['warmup']['seconds']:.2f}s -> {warm['recompiles']} recompiles "
+        f"over {warm['steps']} steps. Manifest: {manifest_path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -294,23 +294,103 @@ class TelemetryKwargs(KwargsHandler):
 
 
 @dataclass
+class CompileKwargs(KwargsHandler):
+    """Compile-manager config (compile_manager.py). Passing this handler to
+    ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it
+    ``accelerator.compile_manager`` is ``None`` and every hook site is a
+    single ``None`` check (behavior byte-identical to the unmanaged path).
+
+    - ``buckets``: shape-bucket policy applied at the device boundary.
+      ``"pow2"`` rounds ragged dims up the power-of-two ladder, ``"fixed"``
+      uses the explicit ``batch_buckets``/``seq_buckets`` ladders, ``"auto"``
+      builds the ladder from the shapes manifest (previously observed shapes;
+      falls back to pow2 for unseen sizes), ``None`` disables bucketing but
+      keeps warmup + cache control.
+    - ``bucket_batch`` / ``bucket_seq``: which dims get bucketed (axis 0 of
+      every array leaf; axis 1 of rank>=2 leaves). The batch dim of a loader
+      batch is padded to the loader's OWN batch size first, so the ragged
+      final ``drop_last=False`` batch stops costing a one-off recompile each
+      epoch.
+    - ``min_bucket`` / ``max_bucket``: pow2-ladder floor and cap. A dim past
+      ``max_bucket`` (or off a fixed ladder) falls through with a one-time
+      warning and ships its true shape.
+    - ``batch_pad_mode``: ``"repeat"`` cycles real samples (the semantics
+      ``even_batches`` already gives the final batch; duplicates are trimmed
+      by ``gather_for_metrics``) or ``"zero"``. Sequence padding always
+      zero-fills with ``seq_pad_value``.
+    - ``emit_mask``: on dict batches, ALWAYS add a ``mask_key`` leaf
+      (1.0 = real element) so masked losses can ignore padding without the
+      batch structure — and the compiled signature — ever changing.
+    - ``warmup``: ``"execute"`` (default) runs the real jitted step on a
+      copy of the train state per manifest signature (the only mode that
+      populates jit's dispatch cache — zero recompiles after warmup);
+      ``"aot"`` does ``lower(abstract).compile()`` (primes the persistent
+      cache only); ``"off"`` disables. ``warmup_calls`` executions per
+      signature absorb the donated-buffer layout specialization (default 2).
+    - ``manifest_path``: shapes-manifest override; default
+      ``<project_dir>/compile_cache/shapes_manifest.jsonl``.
+    - ``cache_budget_bytes``: LRU prune budget for the persistent executable
+      cache (falls back to ``JitConfig.persistent_cache_budget_bytes``).
+    """
+
+    enabled: bool = True
+    buckets: Optional[str] = "pow2"  # pow2 | fixed | auto | None
+    bucket_batch: bool = True
+    bucket_seq: bool = True
+    batch_buckets: Optional[list] = None
+    seq_buckets: Optional[list] = None
+    min_bucket: int = 8
+    max_bucket: Optional[int] = None
+    batch_pad_mode: str = "repeat"  # repeat | zero
+    seq_pad_value: int = 0
+    emit_mask: bool = False
+    mask_key: str = "pad_mask"
+    warmup: str = "execute"  # execute | aot | off
+    warmup_calls: int = 2
+    manifest_path: Optional[str] = None
+    cache_budget_bytes: Optional[int] = None
+
+    def __post_init__(self):
+        if self.buckets not in (None, "pow2", "fixed", "auto"):
+            raise ValueError("buckets must be one of pow2|fixed|auto|None")
+        if self.batch_pad_mode not in ("repeat", "zero"):
+            raise ValueError("batch_pad_mode must be repeat|zero")
+        if self.warmup not in ("execute", "aot", "off"):
+            raise ValueError("warmup must be execute|aot|off")
+
+
+@dataclass
 class JitConfig(KwargsHandler):
     """Compilation policy — the role of the reference's TorchDynamoPlugin
     (reference: utils/dataclasses.py:1031-1118). XLA jit is always on; these
-    knobs tune it."""
+    knobs tune it. ``persistent_cache_dir`` is validated at Accelerator init
+    (created; a one-time warning instead of silently handing a bad path to
+    ``jax.config``) and managed — hit/size stats and LRU pruning — when a
+    :class:`CompileKwargs` handler is present (compile_manager.py)."""
 
     donate_state: bool = True            # donate params/opt-state buffers to the step
     remat_policy: str = "none"           # none | full | dots_saveable | offload
     scan_layers: bool = True             # roll repeated blocks into lax.scan ("regional compile")
     persistent_cache_dir: Optional[str] = None
+    # Only compiles slower than this hit the persistent cache (jax's own
+    # knob; tiny executables cost more to deserialize than to rebuild).
+    persistent_cache_min_compile_time_secs: float = 1.0
+    # mtime-LRU prune budget applied at Accelerator.end_training (None = no
+    # pruning; requires the compile manager).
+    persistent_cache_budget_bytes: Optional[int] = None
 
     @classmethod
     def from_env(cls) -> "JitConfig":
+        budget = os.environ.get("ACCELERATE_JIT_CACHE_BUDGET_BYTES")
         return cls(
             donate_state=parse_flag_from_env("ACCELERATE_JIT_DONATE", True),
             remat_policy=parse_choice_from_env("ACCELERATE_REMAT_POLICY", "none"),
             scan_layers=parse_flag_from_env("ACCELERATE_SCAN_LAYERS", True),
             persistent_cache_dir=os.environ.get("ACCELERATE_JIT_CACHE_DIR"),
+            persistent_cache_min_compile_time_secs=float(
+                os.environ.get("ACCELERATE_JIT_CACHE_MIN_COMPILE_S", "1.0") or 1.0
+            ),
+            persistent_cache_budget_bytes=int(budget) if budget else None,
         )
 
 

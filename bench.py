@@ -233,9 +233,13 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
 
     state = acc.train_state
     # Two warmups: initial compile + the donated-buffer-layout recompile.
+    # Timed: warmup_s is the compile stall a cold start pays (the compile
+    # manager's manifest warmup moves exactly this off the training clock).
+    t_w = time.perf_counter()
     for _ in range(2):
         state, metrics = step(state, b)
         float(np.asarray(metrics["loss"]))
+    warmup_s = time.perf_counter() - t_w
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -264,6 +268,7 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
         "device_kind": kind,
         "precision": precision,
         "remat_policy": cfg.remat_policy,
+        "warmup_s": warmup_s,
         "telemetry": telemetry,
     }
 
@@ -298,10 +303,14 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
         "params_b": round(r2k["n_params"] / 1e9, 3),
         "device_kind": r2k["device_kind"],
         "platform": platform,
+        # Cold-start compile stall (the 2-step warmup loop, dominated by the
+        # XLA compiles) — the number the compile manager's AOT warmup and
+        # persistent cache exist to shrink across rounds.
+        "warmup_s_2048": round(r2k["warmup_s"], 2),
     }
     if r2k.get("telemetry"):
-        # Step-time distribution + recompile/HBM accounting from the
-        # telemetry subsystem (telemetry.py) — BENCH_*.json carries it so
+        # Step-time distribution + recompile/HBM/executable accounting from
+        # the telemetry subsystem (telemetry.py) — BENCH_*.json carries it so
         # future rounds can compare trajectories, not just the headline mean.
         t = r2k["telemetry"]
         result["telemetry"] = {
@@ -314,6 +323,7 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 "data_wait_mean_s",
                 "recompiles",
                 "peak_hbm_bytes",
+                "executables",
             )
         }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
@@ -334,6 +344,7 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 r8k = _measure(8192, 15, lvl, on_chip)
                 result["tok_s_8192"] = round(r8k["tok_s"], 1)
                 result["mfu_8192"] = round(r8k["mfu"], 4)
+                result["warmup_s_8192"] = round(r8k["warmup_s"], 2)
                 extra = f"; seq-8192: {r8k['tok_s']:.0f} tok/s/chip MFU {r8k['mfu']:.3f}"
                 err8k = None
                 break
