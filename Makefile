@@ -6,7 +6,7 @@ PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
-        telemetry-smoke warmup-smoke
+        telemetry-smoke warmup-smoke faulttol-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -81,6 +81,13 @@ telemetry-smoke:
 # docs/usage_guides/performance.md "Taming recompiles".
 warmup-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.warmup_smoke
+
+# Fault-tolerance gate: SIGTERM a training worker mid-epoch (preemption
+# auto-save + resumable exit code), relaunch with ACCELERATE_RESTART_ATTEMPT=1
+# and assert the resumed step equals the preemption-save step and the final
+# loss matches an uninterrupted run. See docs/usage_guides/fault_tolerance.md.
+faulttol-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.faulttol_smoke
 
 # Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
 # relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8

@@ -77,6 +77,7 @@ from .utils import (
 from .utils.dataclasses import (
     CompileKwargs,
     DistributedDataParallelKwargs,
+    FaultToleranceKwargs,
     KwargsHandler,
     ProfileKwargs,
     TelemetryKwargs,
@@ -193,6 +194,7 @@ class Accelerator:
         self.ddp_handler = None
         self.telemetry_handler = None
         self.compile_handler = None
+        self.fault_tolerance_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -206,6 +208,8 @@ class Accelerator:
                 self.telemetry_handler = handler
             elif isinstance(handler, CompileKwargs):
                 self.compile_handler = handler
+            elif isinstance(handler, FaultToleranceKwargs):
+                self.fault_tolerance_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -285,6 +289,17 @@ class Accelerator:
             from .compile_manager import CompileManager
 
             self.compile_manager = CompileManager(self, self.compile_handler)
+
+        # Fault tolerance (fault_tolerance.py): atomic verified checkpoints,
+        # preemption auto-save, save retry, divergence sentinel. Same
+        # contract as telemetry — off unless a FaultToleranceKwargs handler
+        # was passed, then every hook site is a None check and the
+        # checkpoint byte layout is unchanged.
+        self.fault_tolerance = None
+        if self.fault_tolerance_handler is not None and self.fault_tolerance_handler.enabled:
+            from .fault_tolerance import FaultToleranceManager
+
+            self.fault_tolerance = FaultToleranceManager(self, self.fault_tolerance_handler)
 
     # ------------------------------------------------------------------
     # Introspection properties (reference: accelerator.py:640-780)
@@ -611,6 +626,11 @@ class Accelerator:
             else:
                 result.append(obj)
         self._maybe_elastic_resume()
+        if self.fault_tolerance is not None:
+            # Rank-coherent by construction: every rank runs prepare(), and
+            # the launcher signals the whole local gang (multi-host coherence
+            # goes through check_preemption's collective).
+            self.fault_tolerance.install_signal_handlers()
         return result[0] if len(result) == 1 else tuple(result)
 
     def _maybe_elastic_resume(self) -> None:
@@ -657,9 +677,12 @@ class Accelerator:
         # rewind to a checkpoint the run itself has since written.
         self._elastic_resumed = True
         base = os.path.join(self.project_dir or ".", "checkpoints")
-        if not os.path.isdir(base) or not any(
-            f.startswith("checkpoint_") for f in os.listdir(base)
-        ):
+        from .checkpointing import _list_checkpoint_dirs
+
+        # _list_checkpoint_dirs, not a bare startswith() scan: a restart whose
+        # ONLY artifact is an interrupted checkpoint_N.tmp staging dir must
+        # start fresh, not crash load_state on an empty resolver result.
+        if not os.path.isdir(base) or not _list_checkpoint_dirs(base):
             logger.warning(
                 "automatic_resume: restart attempt %d but no checkpoints under "
                 "%s — starting fresh.", attempt, base,
@@ -1391,7 +1414,7 @@ class Accelerator:
                 # the previous state's arrays are dead after this call, so
                 # save_state, Model.__call__ and trackers must see the new one.
                 self._train_states[slot] = new_state
-                return new_state, metrics
+                return self._maybe_sentinel(new_state, metrics, slot), metrics
             t0 = time.perf_counter()
             new_state, metrics = jitted(state, batch)
             if tel.handler.sync_timing:
@@ -1399,9 +1422,22 @@ class Accelerator:
             wall = time.perf_counter() - t0
             self._train_states[slot] = new_state
             tel.on_train_step(jitted, batch, wall, metrics=metrics)
-            return new_state, metrics
+            return self._maybe_sentinel(new_state, metrics, slot), metrics
 
         return step_and_track
+
+    def _maybe_sentinel(self, new_state: TrainState, metrics, slot: int) -> TrainState:
+        """Divergence-sentinel hook shared by every prepared-step wrapper:
+        feeds the step metrics to fault tolerance (lagged host fetch — never
+        stalls dispatch) and, when the sentinel rolled back, hands the
+        RESTORED state back to the training loop in place of the diverged
+        one (the loop's local ``state`` variable would otherwise keep
+        training the garbage)."""
+        ft = self.fault_tolerance
+        if ft is None:
+            return new_state
+        restored = ft.observe_step(metrics, slot=slot)
+        return restored if restored is not None else new_state
 
     def warmup_compile(self) -> Optional[dict]:
         """Compile every shapes-manifest signature against the prepared train
@@ -1615,7 +1651,7 @@ class Accelerator:
                 if tel.handler.sync_timing:
                     jax.block_until_ready(metrics)
                 tel.on_train_step(jitted, batch, time.perf_counter() - t0, metrics=metrics)
-            return new_state, metrics
+            return self._maybe_sentinel(new_state, metrics, slot), metrics
 
         return step_and_track
 
@@ -1668,6 +1704,40 @@ class Accelerator:
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
         return extract_model_from_parallel(model, keep_fp32_wrapper)
+
+    # -- preemption observation (fault_tolerance.py) ----------------------
+
+    def should_checkpoint(self) -> bool:
+        """True once this process received a preemption signal
+        (SIGTERM/SIGUSR1) and a final save should happen NOW. Local and
+        free — poll it every step. On multi-host meshes where only some
+        hosts get the signal, use :meth:`check_preemption` (collective)
+        at a coarser cadence instead so the gang saves coherently."""
+        ft = self.fault_tolerance
+        return ft is not None and ft.preempted
+
+    def check_preemption(self) -> bool:
+        """Collective preemption poll: True on EVERY rank as soon as ANY
+        rank received a preemption signal (one tiny allreduce — call it
+        every step or every N steps). After the final ``save_state()``,
+        exit with :attr:`preemption_exit_code` so the launch gang loop
+        relaunches the run as resumable."""
+        ft = self.fault_tolerance
+        if ft is None:
+            return False
+        if self.num_processes <= 1:
+            return ft.preempted
+        return self.state.agree_any(ft.preempted)
+
+    @property
+    def preemption_exit_code(self) -> int:
+        """Exit code a preemption-triggered shutdown should use
+        (``utils.constants.PREEMPTION_EXIT_CODE``): the ``accelerate-tpu
+        launch`` gang loop treats it as resumable and relaunches with
+        ``ACCELERATE_RESTART_ATTEMPT`` bumped."""
+        from .utils.constants import PREEMPTION_EXIT_CODE
+
+        return PREEMPTION_EXIT_CODE
 
     # -- trigger sync (reference: accelerator.py:2852-2909) ---------------
 
@@ -1762,25 +1832,90 @@ class Accelerator:
         thread while training continues (orbax async — the step's donated
         buffers are safe, the snapshot is already on host). Call
         :meth:`wait_for_checkpoint` (or ``end_training``) to drain; a second
-        async save waits for the first. The reference has no async tier."""
+        async save waits for the first. The reference has no async tier.
+
+        With a :class:`~accelerate_tpu.utils.FaultToleranceKwargs` handler
+        the save stages into ``<dir>.tmp``, commits atomically via
+        manifest+rename, and transient storage failures retry with backoff
+        (falling back to ``fallback_dir`` when configured)."""
         from .checkpointing import _checkpoint_dir, save_accelerator_state
 
-        if self._save_state_pre_hooks:
-            # Hooks see the RESOLVED target (automatic_checkpoint_naming makes
-            # the raw arg None) so sidecar writers land next to the checkpoint.
-            resolved = _checkpoint_dir(self, output_dir)
-            for hook in self._save_state_pre_hooks:
-                hook(self._models, self._train_state, resolved)
-            output_dir = resolved
-        return save_accelerator_state(
-            self, output_dir, safe_serialization=safe_serialization, block=block
-        )
+        ft = self.fault_tolerance
+        if ft is None:
+            if self._save_state_pre_hooks:
+                # Hooks see the RESOLVED target (automatic_checkpoint_naming
+                # makes the raw arg None) so sidecar writers land next to the
+                # checkpoint.
+                resolved = _checkpoint_dir(self, output_dir)
+                for hook in self._save_state_pre_hooks:
+                    hook(self._models, self._train_state, resolved)
+                output_dir = resolved
+            return save_accelerator_state(
+                self, output_dir, safe_serialization=safe_serialization, block=block
+            )
+
+        resolved = _checkpoint_dir(self, output_dir)
+
+        def do_save(target: str) -> str:
+            if self._save_state_pre_hooks:
+                from .fault_tolerance import staging_path
+
+                # Under atomic saves the hooks write into the STAGING dir so
+                # their sidecar files are covered by the manifest and ride
+                # the same commit; do_save re-runs them on every retry
+                # attempt (the retry loop clears the staging dir between
+                # attempts).
+                hook_dir = staging_path(target) if ft.atomic else target
+                if ft.atomic:
+                    import shutil
+
+                    if self.is_main_process and os.path.isdir(hook_dir):
+                        shutil.rmtree(hook_dir)
+                    self.wait_for_everyone()
+                    os.makedirs(hook_dir, exist_ok=True)
+                    # Tell save_accelerator_state this staging dir is live
+                    # (hook sidecar files), not a stale leftover to wipe.
+                    ft.prearm_staging(hook_dir)
+                for hook in self._save_state_pre_hooks:
+                    hook(self._models, self._train_state, hook_dir)
+            return save_accelerator_state(
+                self, target, safe_serialization=safe_serialization, block=block
+            )
+
+        return ft.run_save_with_retry(do_save, resolved)
 
     def wait_for_checkpoint(self):
-        """Block until any in-flight async checkpoint finished persisting."""
+        """Block until any in-flight async checkpoint finished persisting.
+        A failure in orbax's background persist thread surfaces HERE (the
+        save call itself already returned): the broken checkpointer is
+        dropped so the next save starts fresh, the failure lands in
+        telemetry, and a
+        :class:`~accelerate_tpu.fault_tolerance.CheckpointSaveError` is
+        raised instead of the error being silently swallowed."""
         ckptr = getattr(self, "_async_checkpointer", None)
-        if ckptr is not None:
+        if ckptr is None:
+            return
+        try:
             ckptr.wait_until_finished()
+            check = getattr(ckptr, "check_for_errors", None)
+            if callable(check):
+                check()
+        except Exception as e:
+            try:
+                ckptr.close()
+            except Exception:
+                pass
+            self._async_checkpointer = None
+            if self.telemetry is not None:
+                self.telemetry.record_event(
+                    "checkpoint_async_error", error=f"{type(e).__name__}: {e}"[:500]
+                )
+            from .fault_tolerance import CheckpointSaveError
+
+            raise CheckpointSaveError(
+                f"async (orbax) checkpoint failed to persist in the "
+                f"background: {e}"
+            ) from e
 
     def _close_async_checkpointer(self):
         ckptr = getattr(self, "_async_checkpointer", None)
@@ -1858,6 +1993,8 @@ class Accelerator:
 
     def end_training(self):
         self._close_async_checkpointer()
+        if self.fault_tolerance is not None:
+            self.fault_tolerance.close()  # drain/restore signal handlers
         if self.telemetry is not None:
             self.telemetry.close()  # summary still sees the compile manager
         if self.compile_manager is not None:
@@ -1875,6 +2012,9 @@ class Accelerator:
         from .utils.memory import release_memory
 
         self._close_async_checkpointer()
+        if self.fault_tolerance is not None:
+            self.fault_tolerance.close()
+            self.fault_tolerance = None
         if self.telemetry is not None:
             self.telemetry.close()
             self.telemetry = None

@@ -41,30 +41,79 @@ from .utils.random import load_rng_state, rng_state
 logger = get_logger(__name__)
 
 
+def _list_checkpoint_dirs(base: str) -> list[str]:
+    """``checkpoint_<N>`` entries under ``base``, sorted by N ascending.
+
+    Non-matching entries — an interrupted ``checkpoint_N.tmp`` staging dir, a
+    stray user-created ``checkpoint_tmp`` folder — are skipped with a
+    one-time warning instead of the old ``int(f.split("_")[1])`` ValueError
+    that crashed both the load resolver and the total_limit pruner."""
+    from .fault_tolerance import checkpoint_index
+
+    found = []
+    for f in os.listdir(base):
+        idx = checkpoint_index(f)
+        if idx is None:
+            if f.startswith("checkpoint_"):
+                logger.warning_once(
+                    f"Ignoring non-checkpoint entry {f!r} in {base} (an "
+                    "interrupted staging dir or a stray folder)."
+                )
+            continue
+        found.append((idx, f))
+    return [f for _, f in sorted(found)]
+
+
 def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = False) -> str:
     pc = accelerator.project_configuration
     if pc.automatic_checkpoint_naming and output_dir is None:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
         if for_load:
-            folders = sorted(
-                (f for f in os.listdir(base) if f.startswith("checkpoint_")),
-                key=lambda f: int(f.split("_")[1]),
-            )
+            folders = _list_checkpoint_dirs(base)
             if not folders:
                 raise FileNotFoundError(f"No checkpoints found in {base}")
-            # Continue numbering past the checkpoint being restored so the
-            # next save doesn't clobber checkpoint_0 (reference:
-            # accelerator.py load_state sets iteration = current + 1). Done
-            # here — the single resolution point — because load_state may
-            # pre-resolve for its pre-hooks, after which
-            # load_accelerator_state sees a non-None input_dir.
-            pc.iteration = int(folders[-1].split("_")[1]) + 1
-            return os.path.join(base, folders[-1])
+            ft = getattr(accelerator, "fault_tolerance", None)
+            if ft is not None and ft.handler.verify_on_load:
+                # Newest checkpoint whose manifest verifies; torn ones are
+                # logged, counted in telemetry and skipped
+                # (fault_tolerance.py).
+                chosen = ft.resolve_verified(base, folders)
+            else:
+                chosen = folders[-1]
+            # Continue numbering past the NEWEST existing checkpoint (even a
+            # torn one the verified walk skipped) so the next save doesn't
+            # clobber anything (reference: accelerator.py load_state sets
+            # iteration = current + 1). Done here — the single resolution
+            # point — because load_state may pre-resolve for its pre-hooks,
+            # after which load_accelerator_state sees a non-None input_dir.
+            from .fault_tolerance import checkpoint_index
+
+            pc.iteration = checkpoint_index(folders[-1]) + 1
+            return os.path.join(base, chosen)
         out = os.path.join(base, f"checkpoint_{pc.iteration}")
         return out
     if output_dir is None:
         raise ValueError("Provide output_dir or enable automatic_checkpoint_naming.")
     return output_dir
+
+
+def _prune_total_limit(accelerator, base: str, room_for: int) -> None:
+    """Drop the oldest checkpoints so ``existing + room_for`` fits
+    ``total_limit``. ``room_for=1`` is the legacy pre-save prune (make room
+    for the save about to happen); ``room_for=0`` is the atomic post-commit
+    prune — run only AFTER a successful commit, so a failed save can never
+    destroy the only good checkpoint."""
+    pc = accelerator.project_configuration
+    if pc.total_limit is None:
+        return
+    existing = _list_checkpoint_dirs(base)
+    excess = len(existing) + room_for - pc.total_limit
+    if excess <= 0:
+        return
+    import shutil
+
+    for f in existing[:excess]:
+        shutil.rmtree(os.path.join(base, f), ignore_errors=True)
 
 
 def _record_checkpoint_event(accelerator, event: str, t0: float, path: str, **fields) -> None:
@@ -196,6 +245,29 @@ def _load_distributed_state(accelerator, state, input_dir: str):
     )
 
 
+def _finalize_save(accelerator, write_dir: str, final_dir: str, step_host) -> None:
+    """Commit point of an atomic save + post-commit housekeeping. No-op
+    (besides the iteration bump the callers keep) for legacy saves."""
+    pc = accelerator.project_configuration
+    ft = getattr(accelerator, "fault_tolerance", None)
+    atomic = ft is not None and ft.atomic
+    # All ranks finished writing into the staging dir before the main
+    # process hashes and renames it — the manifest must certify every rank's
+    # files (per-rank RNG pickles included).
+    accelerator.wait_for_everyone()
+    if atomic and accelerator.is_main_process:
+        ft.commit(write_dir, final_dir, step_host)
+    if pc.automatic_checkpoint_naming:
+        pc.iteration += 1
+    accelerator.wait_for_everyone()
+    # total_limit pruning moves AFTER the successful commit under atomic
+    # saves: a save that dies mid-write leaves every older checkpoint
+    # untouched (the legacy path keeps its pre-save prune for byte-identical
+    # default-off behavior).
+    if atomic and pc.automatic_checkpoint_naming and accelerator.is_main_process:
+        _prune_total_limit(accelerator, os.path.dirname(final_dir), room_for=0)
+
+
 def save_accelerator_state(
     accelerator,
     output_dir: Optional[str] = None,
@@ -204,6 +276,8 @@ def save_accelerator_state(
 ) -> str:
     t_save0 = time.perf_counter()
     pc = accelerator.project_configuration
+    ft = getattr(accelerator, "fault_tolerance", None)
+    atomic = ft is not None and ft.atomic
     # Any save first drains an in-flight async save: pruning below may rmtree
     # the directory it is persisting into, and a sync save with force=True
     # would race the background writer on the same path.
@@ -213,18 +287,32 @@ def save_accelerator_state(
     if pc.automatic_checkpoint_naming and accelerator.is_main_process:
         base = os.path.dirname(output_dir)
         os.makedirs(base, exist_ok=True)
-        existing = sorted(
-            (f for f in os.listdir(base) if f.startswith("checkpoint_")),
-            key=lambda f: int(f.split("_")[1]),
-        )
-        # total_limit pruning (reference: accelerator.py:3622-3647).
-        if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
+        # total_limit pruning (reference: accelerator.py:3622-3647). Under
+        # atomic saves this moves to _finalize_save (post-commit) so a
+        # failed save can no longer destroy the only good checkpoint.
+        if not atomic:
+            _prune_total_limit(accelerator, base, room_for=1)
+    accelerator.wait_for_everyone()
+    if atomic:
+        from .fault_tolerance import staging_path
+
+        write_dir = staging_path(output_dir)
+        if (
+            accelerator.is_main_process
+            and os.path.isdir(write_dir)
+            and not ft.consume_prearmed(write_dir)
+        ):
+            # Stale staging from a previous failed/killed attempt: it is
+            # unverifiable by construction — start clean. (A PRE-ARMED
+            # staging dir — save_state just cleared it and ran the pre-save
+            # hooks into it — is kept: those sidecar files ride this commit.)
             import shutil
 
-            for f in existing[: len(existing) + 1 - pc.total_limit]:
-                shutil.rmtree(os.path.join(base, f), ignore_errors=True)
-    accelerator.wait_for_everyone()
-    os.makedirs(output_dir, exist_ok=True)
+            shutil.rmtree(write_dir)
+        accelerator.wait_for_everyone()
+    else:
+        write_dir = output_dir
+    os.makedirs(write_dir, exist_ok=True)
 
     state = accelerator._train_state
     if state is None:
@@ -255,11 +343,18 @@ def save_accelerator_state(
                 "prepared model; use FULL/SHARDED_STATE_DICT for multi-model "
                 "training runs."
             )
-        _save_distributed_state(accelerator, state, output_dir, block=block)
-        _save_host_side_state(accelerator, state, output_dir)
-        if pc.automatic_checkpoint_naming:
-            pc.iteration += 1
-        accelerator.wait_for_everyone()
+        if atomic and not block:
+            # The manifest+rename commit certifies bytes already on disk; an
+            # async background writer would commit a half-persisted dir.
+            logger.warning_once(
+                "fault_tolerance: atomic checkpoints commit only after every "
+                "byte persists — save_state(block=False) runs blocking while "
+                "a FaultToleranceKwargs handler is active."
+            )
+            block = True
+        _save_distributed_state(accelerator, state, write_dir, block=block)
+        _save_host_side_state(accelerator, state, write_dir)
+        _finalize_save(accelerator, write_dir, output_dir, int(np.asarray(state.step)))
         _record_checkpoint_event(
             accelerator, "checkpoint_save", t_save0, output_dir,
             format="orbax", blocking=bool(block),
@@ -276,7 +371,7 @@ def save_accelerator_state(
     params_host = to_global_host(state.params)
     if accelerator.is_main_process:
         save_sharded_safetensors(
-            flatten_state_dict(params_host), output_dir,
+            flatten_state_dict(params_host), write_dir,
             max_shard_size=max_shard, weights_name=f"{MODEL_NAME}.safetensors",
         )
 
@@ -292,7 +387,7 @@ def save_accelerator_state(
         if state.extra_state else None
     )
     if accelerator.is_main_process:
-        with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
+        with open(os.path.join(write_dir, f"{OPTIMIZER_NAME}.bin"), "wb") as f:
             pickle.dump(
                 {"opt_state": opt_host, "step": step_host, "extra_state": extra_host}, f
             )
@@ -314,7 +409,7 @@ def save_accelerator_state(
         )
         if accelerator.is_main_process:
             save_sharded_safetensors(
-                flatten_state_dict(params_host_i), output_dir,
+                flatten_state_dict(params_host_i), write_dir,
                 max_shard_size=max_shard, weights_name=f"{MODEL_NAME}_{i}.safetensors",
             )
             payload = {
@@ -322,13 +417,11 @@ def save_accelerator_state(
                 "step": int(np.asarray(extra_st.step)),
                 "extra_state": extra_host_i,
             }
-            with open(os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "wb") as f:
+            with open(os.path.join(write_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "wb") as f:
                 pickle.dump(payload, f)
-    _save_host_side_state(accelerator, state, output_dir)
+    _save_host_side_state(accelerator, state, write_dir)
 
-    if pc.automatic_checkpoint_naming:
-        pc.iteration += 1
-    accelerator.wait_for_everyone()
+    _finalize_save(accelerator, write_dir, output_dir, step_host)
     _record_checkpoint_event(
         accelerator, "checkpoint_save", t_save0, output_dir, format="safetensors",
     )
@@ -356,6 +449,11 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     if hasattr(accelerator, "wait_for_checkpoint"):
         accelerator.wait_for_checkpoint()  # never read a half-persisted save
     input_dir = _checkpoint_dir(accelerator, input_dir, for_load=True)
+    ft = getattr(accelerator, "fault_tolerance", None)
+    if ft is not None and ft.handler.verify_on_load:
+        # Explicit paths get verified here; the automatic resolver's pick
+        # was already verified during resolution and is skipped.
+        ft.verify_before_load(input_dir)
     state = accelerator._train_state
     if state is None:
         raise RuntimeError("Call accelerator.prepare(...) before load_state().")
@@ -389,7 +487,18 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         lambda arr, s: jax.device_put(arr, s), params_host, shardings.params
     )
 
-    with open(os.path.join(input_dir, f"{OPTIMIZER_NAME}.bin"), "rb") as f:
+    opt_path = os.path.join(input_dir, f"{OPTIMIZER_NAME}.bin")
+    if not os.path.exists(opt_path):
+        raise FileNotFoundError(
+            f"Checkpoint {input_dir} has no {OPTIMIZER_NAME}.bin — the save "
+            "was interrupted or the directory is not a full training "
+            "checkpoint. Pass FaultToleranceKwargs to "
+            "Accelerator(kwargs_handlers=[...]): saves then commit "
+            "atomically with a verification manifest and load_state() "
+            "automatically skips torn checkpoints, restoring the newest "
+            "verified one instead."
+        )
+    with open(opt_path, "rb") as f:
         opt_payload = pickle.load(f)
     new_opt = jax.tree.map(
         lambda arr, s: jax.device_put(np.asarray(arr), s)

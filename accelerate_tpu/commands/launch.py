@@ -177,16 +177,30 @@ def launch_command(args: argparse.Namespace) -> int:
     # so stale coordinator state can't poison the retry.
     max_restarts = max(0, int(getattr(args, "max_restarts", 0) or 0))
     monitor_interval = float(getattr(args, "monitor_interval", 0.2) or 0.2)
+    from ..utils.constants import PREEMPTION_EXIT_CODE
+
     for attempt in range(max_restarts + 1):
         rc = _run_gang(cmd, base_env, cfg, port, monitor_interval, attempt)
         if rc in (0, 130):
             return rc
         if attempt < max_restarts:
-            print(
-                f"[accelerate-tpu] attempt {attempt} failed (rc={rc}); "
-                f"restarting gang ({max_restarts - attempt} restarts left)",
-                file=sys.stderr,
-            )
+            if rc == PREEMPTION_EXIT_CODE:
+                # A preemption-triggered save completed and the workers asked
+                # for a resumable restart (fault_tolerance.py): the relaunch
+                # carries ACCELERATE_RESTART_ATTEMPT so elastic auto-resume
+                # continues from the preemption checkpoint.
+                print(
+                    f"[accelerate-tpu] attempt {attempt}: preemption save "
+                    f"complete (rc={rc}); relaunching gang to resume "
+                    f"({max_restarts - attempt} restarts left)",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"[accelerate-tpu] attempt {attempt} failed (rc={rc}); "
+                    f"restarting gang ({max_restarts - attempt} restarts left)",
+                    file=sys.stderr,
+                )
             port = None  # re-draw a fresh port next attempt
     return rc
 

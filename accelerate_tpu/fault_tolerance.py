@@ -1,0 +1,648 @@
+"""Fault-tolerance subsystem (layer L11 — robustness).
+
+Production pod-scale training runs on preemptible capacity: the scheduler
+can SIGTERM the gang mid-``save_state``, storage can flake mid-write, and a
+bad data shard can NaN a run hours before a human notices. Each failure mode
+has a narrow, composable answer here:
+
+- **Atomic verified checkpoints** — every save stages into
+  ``checkpoint_N.tmp``, fsyncs, writes a ``manifest.json`` (per-file sizes +
+  checksums + step + world size) and renames to ``checkpoint_N`` as the
+  single commit point. A kill at ANY byte of the save leaves either the
+  previous checkpoint set untouched or a ``.tmp`` dir the load resolver
+  ignores. ``load_state()`` walks newest→oldest and restores the newest
+  checkpoint whose manifest verifies, logging and skipping torn ones, and
+  ``total_limit`` pruning runs *after* the commit so a failed save can never
+  destroy the only good checkpoint.
+- **Preemption-aware auto-save** — SIGTERM/SIGUSR1 handlers installed at
+  ``prepare()`` (drained at ``end_training``) set a flag the loop observes
+  via ``accelerator.should_checkpoint()`` / ``check_preemption()``; the loop
+  then takes a final blocking save and exits with
+  :data:`~accelerate_tpu.utils.constants.PREEMPTION_EXIT_CODE`, which the
+  ``accelerate-tpu launch`` gang loop treats as *resumable* — the relaunch
+  carries ``ACCELERATE_RESTART_ATTEMPT`` so elastic auto-resume continues
+  from the preemption save with zero lost steps.
+- **Save retry with backoff** — transient storage errors retry with
+  jittered exponential backoff before falling back to a secondary directory.
+- **Divergence sentinel** — watches each step's loss/grad-norm (fetched one
+  step lagged, so the watch never stalls async dispatch) for K consecutive
+  nonfinite or exploding steps, then applies policy ``warn | halt |
+  rollback``; rollback restores the newest *verified* checkpoint and
+  re-primes RNG/dataloader state so the run resumes deterministically.
+
+Default off: without a :class:`~accelerate_tpu.utils.FaultToleranceKwargs`
+handler, ``accelerator.fault_tolerance`` is ``None``, every hook is a single
+``None`` check, and the checkpoint byte layout is byte-identical to the
+unmanaged path. All events (save retries, torn checkpoints skipped,
+preemption saves, rollbacks) flow into the telemetry JSONL (telemetry.py)
+when that subsystem is also enabled, so recovery actions are attributable
+alongside step times.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import re
+import shutil
+import signal
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .logging import get_logger
+from .utils.constants import (
+    CHECKPOINT_DIR_REGEX,
+    CHECKPOINT_MANIFEST_NAME,
+    CHECKPOINT_STAGING_SUFFIX,
+    PREEMPTION_EXIT_CODE,
+)
+
+logger = get_logger(__name__)
+
+_CKPT_RE = re.compile(CHECKPOINT_DIR_REGEX)
+
+MANIFEST_VERSION = 1
+
+
+class CheckpointSaveError(RuntimeError):
+    """A checkpoint save failed after exhausting retries (and the fallback
+    directory, when configured)."""
+
+
+class DivergenceError(RuntimeError):
+    """The divergence sentinel halted training (policy ``halt``, or
+    ``rollback`` with no verified checkpoint / retries exhausted)."""
+
+
+def checkpoint_index(name: str) -> Optional[int]:
+    """``checkpoint_12`` -> 12; anything else (``checkpoint_12.tmp``, a stray
+    user dir) -> None. The single name-parsing point shared by the load
+    resolver and the pruner — both previously crashed on non-numeric
+    entries via ``int(f.split("_")[1])``."""
+    m = _CKPT_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def staging_path(final_dir: str) -> str:
+    return final_dir + CHECKPOINT_STAGING_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# Manifest: write / verify
+# ---------------------------------------------------------------------------
+
+
+def _iter_checkpoint_files(root: str):
+    """Relative paths of every regular file under ``root`` (sorted for a
+    stable manifest), excluding the manifest itself."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            if rel == CHECKPOINT_MANIFEST_NAME:
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def _file_sha256(path: str, chunk: int = 4 * 1024 * 1024) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_manifest(
+    ckpt_dir: str, step: Optional[int], world_size: int, checksum: str = "sha256"
+) -> dict:
+    """Hash + fsync every file in ``ckpt_dir`` and durably write
+    ``manifest.json``. The manifest is the LAST file written: its presence
+    (inside a committed, renamed dir) certifies every byte it lists."""
+    files = {}
+    for rel in _iter_checkpoint_files(ckpt_dir):
+        path = os.path.join(ckpt_dir, rel)
+        entry = {"size": os.path.getsize(path)}
+        if checksum == "sha256":
+            entry["sha256"] = _file_sha256(path)
+        files[rel] = entry
+        _fsync_file(path)
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "step": step,
+        "world_size": world_size,
+        "checksum": checksum,
+        "time": time.time(),
+        "files": files,
+    }
+    mpath = os.path.join(ckpt_dir, CHECKPOINT_MANIFEST_NAME)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(ckpt_dir)
+    return manifest
+
+
+def verify_checkpoint(ckpt_dir: str, check_hashes: bool = True) -> tuple[bool, str]:
+    """Validate ``ckpt_dir`` against its manifest. Returns ``(ok, reason)``;
+    ``reason`` is ``"no-manifest"`` for legacy (pre-fault-tolerance) dirs so
+    callers can choose whether to trust them."""
+    mpath = os.path.join(ckpt_dir, CHECKPOINT_MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return False, "no-manifest"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest ({e})"
+    files = manifest.get("files")
+    if not isinstance(files, dict):
+        return False, "malformed manifest (no files map)"
+    for rel, entry in files.items():
+        path = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(path):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(path)
+        if size != entry.get("size"):
+            return False, f"size mismatch for {rel} ({size} != {entry.get('size')})"
+        want = entry.get("sha256")
+        if check_hashes and want is not None:
+            got = _file_sha256(path)
+            if got != want:
+                return False, f"checksum mismatch for {rel}"
+    return True, "ok"
+
+
+# ---------------------------------------------------------------------------
+# Divergence sentinel (policy-only core — unit-testable without a mesh)
+# ---------------------------------------------------------------------------
+
+
+class DivergenceSentinel:
+    """Streak detector over (loss, grad_norm) samples. Pure host-side state:
+    feed it floats, it answers ``"ok" | "warn" | "trip"``. The manager maps
+    ``trip`` onto the configured policy."""
+
+    def __init__(self, window: int, explode_factor: float, ema_alpha: float):
+        self.window = window
+        self.explode_factor = explode_factor
+        self.ema_alpha = ema_alpha
+        self.ema_loss: Optional[float] = None
+        self.streak = 0
+        self.episode_warned = False
+
+    def classify(self, loss: Optional[float], grad_norm: Optional[float]) -> tuple[bool, str]:
+        """Is this sample bad, and why."""
+        if loss is not None and not np.isfinite(loss):
+            return True, f"nonfinite loss {loss}"
+        if grad_norm is not None and not np.isfinite(grad_norm):
+            return True, f"nonfinite grad norm {grad_norm}"
+        if (
+            loss is not None
+            and self.ema_loss is not None
+            and abs(loss) > self.explode_factor * max(abs(self.ema_loss), 1e-8)
+        ):
+            return True, (
+                f"loss {loss:.4g} exploded past {self.explode_factor:g}x "
+                f"EMA {self.ema_loss:.4g}"
+            )
+        return False, ""
+
+    def observe(self, loss: Optional[float], grad_norm: Optional[float]) -> tuple[str, str]:
+        """Feed one sample; returns ``(verdict, reason)`` where verdict is
+        ``"ok"``, ``"warn"`` (healthy sample after a bad streak reset, or a
+        bad sample below the streak threshold) or ``"trip"`` (streak just
+        reached the window)."""
+        bad, reason = self.classify(loss, grad_norm)
+        if not bad:
+            if loss is not None:
+                self.ema_loss = (
+                    loss
+                    if self.ema_loss is None
+                    else self.ema_alpha * loss + (1 - self.ema_alpha) * self.ema_loss
+                )
+            self.streak = 0
+            self.episode_warned = False
+            return "ok", ""
+        self.streak += 1
+        if self.streak >= self.window:
+            return "trip", reason
+        return "warn", reason
+
+    def reset(self):
+        self.streak = 0
+        self.episode_warned = False
+        self.ema_loss = None
+
+
+# ---------------------------------------------------------------------------
+# Manager
+# ---------------------------------------------------------------------------
+
+
+class FaultToleranceManager:
+    """One per Accelerator, created when a
+    :class:`~accelerate_tpu.utils.FaultToleranceKwargs` handler is passed;
+    every hook site no-ops through a ``None`` check when absent."""
+
+    def __init__(self, accelerator, handler):
+        self.accelerator = accelerator
+        self.handler = handler
+        self.preempted = False
+        self.preemption_signal: Optional[str] = None
+        self._installed: dict[int, object] = {}  # signum -> previous handler
+        self.sentinel = DivergenceSentinel(
+            handler.sentinel_window,
+            handler.sentinel_explode_factor,
+            handler.sentinel_ema_alpha,
+        )
+        # Lagged metric fetch: the sentinel reads step N-1's loss while step
+        # N dispatches, so the host never waits on an in-flight step.
+        self._pending_metrics = None
+        self.rollbacks_done = 0
+        self.save_retries_total = 0
+        self._last_verified_dir: Optional[str] = None
+        # Staging dirs save_state already cleared and seeded (pre-hook
+        # sidecar files): save_accelerator_state must NOT re-wipe these as
+        # stale leftovers.
+        self._prearmed_staging: set[str] = set()
+
+    # -- telemetry bridge --------------------------------------------------
+
+    def _event(self, event: str, **fields) -> None:
+        tel = getattr(self.accelerator, "telemetry", None)
+        if tel is not None:
+            tel.record_event(event, **fields)
+
+    # -- atomic commit -----------------------------------------------------
+
+    @property
+    def atomic(self) -> bool:
+        return bool(self.handler.atomic_checkpoints)
+
+    def prearm_staging(self, staging_dir: str) -> None:
+        self._prearmed_staging.add(os.path.abspath(staging_dir))
+
+    def consume_prearmed(self, staging_dir: str) -> bool:
+        """True exactly once per prearm_staging() call for this dir."""
+        path = os.path.abspath(staging_dir)
+        if path in self._prearmed_staging:
+            self._prearmed_staging.discard(path)
+            return True
+        return False
+
+    def commit(self, staging_dir: str, final_dir: str, step: Optional[int]) -> None:
+        """Main-process commit point: manifest + fsync + rename. Callers
+        barrier around this."""
+        t0 = time.perf_counter()
+        write_manifest(
+            staging_dir,
+            step,
+            self.accelerator.num_processes,
+            checksum=self.handler.checksum,
+        )
+        if os.path.isdir(final_dir):
+            # A same-name leftover (e.g. a clobbered retry target) would make
+            # the rename fail; the staged copy is the one the manifest
+            # certifies.
+            shutil.rmtree(final_dir)
+        os.replace(staging_dir, final_dir)
+        _fsync_dir(os.path.dirname(final_dir) or ".")
+        self._event(
+            "checkpoint_verify",
+            seconds=time.perf_counter() - t0,
+            dir=final_dir,
+            phase="commit",
+        )
+
+    # -- verified load resolution -----------------------------------------
+
+    def verify_before_load(self, input_dir: str) -> None:
+        """Pre-restore guard for EXPLICIT checkpoint paths (the automatic
+        resolver already verified its pick — that one is skipped here, not
+        re-hashed). A torn explicit dir raises before any state is touched;
+        a manifest-less legacy dir passes with a one-time warning."""
+        if input_dir == getattr(self, "_last_verified_dir", None):
+            return
+        t0 = time.perf_counter()
+        ok, reason = verify_checkpoint(
+            input_dir, check_hashes=self.handler.checksum == "sha256"
+        )
+        self._event(
+            "checkpoint_verify",
+            seconds=time.perf_counter() - t0,
+            dir=input_dir,
+            ok=ok,
+            reason=reason,
+            phase="load",
+        )
+        if ok:
+            return
+        if reason == "no-manifest":
+            logger.warning_once(
+                f"fault_tolerance: {input_dir} has no manifest (saved before "
+                "fault tolerance was enabled) — restoring it unverified."
+            )
+            return
+        self._event("checkpoint_torn_skipped", dir=input_dir, reason=reason)
+        raise RuntimeError(
+            f"Refusing to restore torn checkpoint {input_dir}: {reason}. "
+            "Use load_state() with automatic_checkpoint_naming to fall back "
+            "to the newest verified checkpoint, or pass verify_on_load=False "
+            "to restore it anyway."
+        )
+
+    def resolve_verified(self, base: str, names_ascending: list[str]) -> str:
+        """Newest name whose manifest verifies; torn ones are logged, counted
+        and skipped. Legacy dirs without a manifest are accepted with a
+        one-time warning (they predate verification and cannot be checked)."""
+        check_hashes = self.handler.checksum == "sha256"
+        for name in reversed(names_ascending):
+            path = os.path.join(base, name)
+            t0 = time.perf_counter()
+            ok, reason = verify_checkpoint(path, check_hashes=check_hashes)
+            self._event(
+                "checkpoint_verify",
+                seconds=time.perf_counter() - t0,
+                dir=path,
+                ok=ok,
+                reason=reason,
+                phase="load",
+            )
+            if ok:
+                self._last_verified_dir = path
+                return name
+            if reason == "no-manifest":
+                logger.warning_once(
+                    f"fault_tolerance: {path} has no manifest (saved before "
+                    "fault tolerance was enabled) — restoring it unverified."
+                )
+                self._last_verified_dir = path
+                return name
+            logger.warning(
+                "fault_tolerance: skipping torn checkpoint %s (%s) — falling "
+                "back to the next older one.",
+                path, reason,
+            )
+            self._event("checkpoint_torn_skipped", dir=path, reason=reason)
+        raise FileNotFoundError(
+            f"No verifiable checkpoint in {base}: every candidate "
+            f"({', '.join(reversed(names_ascending))}) failed manifest "
+            "verification."
+        )
+
+    # -- save retry / fallback ---------------------------------------------
+
+    def run_save_with_retry(self, do_save: Callable[[str], str], target_dir: str) -> str:
+        """Run ``do_save(target_dir)`` with jittered exponential backoff on
+        failure, then once against the fallback directory (same basename)
+        when configured. Raises :class:`CheckpointSaveError` after that."""
+        h = self.handler
+        delay = max(0.0, float(h.retry_backoff_s))
+        last_err: Optional[Exception] = None
+        for attempt in range(max(0, int(h.save_retries)) + 1):
+            try:
+                out = do_save(target_dir)
+                self._note_preemption_save(out)
+                return out
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # OSError / TensorStore / safetensors I/O
+                last_err = e
+                shutil.rmtree(staging_path(target_dir), ignore_errors=True)
+                if attempt < h.save_retries:
+                    self.save_retries_total += 1
+                    sleep_s = delay * (0.5 + random.random())
+                    logger.warning(
+                        "fault_tolerance: checkpoint save to %s failed "
+                        "(attempt %d/%d, %s: %s); retrying in %.2fs.",
+                        target_dir, attempt + 1, h.save_retries,
+                        type(e).__name__, e, sleep_s,
+                    )
+                    self._event(
+                        "checkpoint_save_retry",
+                        dir=target_dir,
+                        attempt=attempt + 1,
+                        error=f"{type(e).__name__}: {e}"[:500],
+                    )
+                    time.sleep(sleep_s)
+                    delay = min(delay * 2 or h.retry_backoff_s, h.retry_backoff_max_s)
+        if h.fallback_dir:
+            fallback_target = os.path.join(
+                h.fallback_dir, os.path.basename(os.path.normpath(target_dir))
+            )
+            logger.warning(
+                "fault_tolerance: primary checkpoint dir exhausted retries "
+                "(%s: %s); falling back to %s.",
+                type(last_err).__name__, last_err, fallback_target,
+            )
+            self._event(
+                "checkpoint_fallback_save",
+                dir=fallback_target,
+                error=f"{type(last_err).__name__}: {last_err}"[:500],
+            )
+            try:
+                os.makedirs(h.fallback_dir, exist_ok=True)
+                out = do_save(fallback_target)
+                self._note_preemption_save(out)
+                return out
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                shutil.rmtree(staging_path(fallback_target), ignore_errors=True)
+                raise CheckpointSaveError(
+                    f"checkpoint save failed in the primary dir ({last_err}) "
+                    f"AND the fallback dir {h.fallback_dir} ({e})"
+                ) from e
+        raise CheckpointSaveError(
+            f"checkpoint save to {target_dir} failed after "
+            f"{h.save_retries + 1} attempt(s): {last_err}"
+        ) from last_err
+
+    def _note_preemption_save(self, out_dir: str) -> None:
+        """A save completed while the preemption flag was up: that save IS
+        the preemption save — record it so the resumed run's zero-lost-steps
+        claim is auditable from the telemetry stream."""
+        if self.preempted:
+            logger.info(
+                "fault_tolerance: preemption save complete (%s, signal %s) — "
+                "exit with PREEMPTION_EXIT_CODE (%d) for a resumable restart.",
+                out_dir, self.preemption_signal, PREEMPTION_EXIT_CODE,
+                main_process_only=True,
+            )
+            self._event(
+                "preemption_save", dir=out_dir, signal=self.preemption_signal
+            )
+
+    # -- preemption signals ------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """Install SIGTERM/SIGUSR1 → preemption-flag handlers. Called from
+        ``prepare()`` on every rank (the launcher signals the whole local
+        gang, so local flags agree; multi-host coherence goes through
+        ``check_preemption``'s collective). Harmless off the main thread or
+        when already installed."""
+        if not self.handler.install_signal_handlers or self._installed:
+            return
+        for name in self.handler.preemption_signals:
+            signum = getattr(signal, name, None)
+            if signum is None:
+                continue
+            try:
+                prev = signal.signal(signum, self._on_signal)
+            except ValueError:
+                # Not the main thread (e.g. a dataloader worker constructed
+                # the Accelerator) — signals only deliver to the main thread
+                # anyway, so there is nothing to install here.
+                logger.warning_once(
+                    "fault_tolerance: cannot install signal handlers outside "
+                    "the main thread; preemption auto-save is disabled for "
+                    "this process."
+                )
+                return
+            self._installed[signum] = prev
+
+    def _on_signal(self, signum, frame) -> None:
+        # Async-signal context: only set flags; the training loop observes
+        # them at the next should_checkpoint()/check_preemption() poll.
+        self.preempted = True
+        try:
+            self.preemption_signal = signal.Signals(signum).name
+        except ValueError:
+            self.preemption_signal = str(signum)
+
+    def uninstall_signal_handlers(self) -> None:
+        for signum, prev in self._installed.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
+        self._installed.clear()
+
+    def clear_preemption(self) -> None:
+        self.preempted = False
+        self.preemption_signal = None
+
+    @property
+    def exit_code(self) -> int:
+        return PREEMPTION_EXIT_CODE
+
+    # -- divergence sentinel hook -----------------------------------------
+
+    def observe_step(self, metrics, slot: int = 0):
+        """Called by the prepared step wrapper after every step. Returns a
+        replacement TrainState when a rollback restored one, else ``None``."""
+        if self.handler.sentinel == "off":
+            return None
+        pending, self._pending_metrics = self._pending_metrics, None
+        if isinstance(metrics, dict):
+            self._pending_metrics = (metrics.get("loss"), metrics.get("grad_norm"), slot)
+        if pending is None:
+            return None
+        loss_arr, gnorm_arr, p_slot = pending
+        try:
+            loss = float(np.asarray(loss_arr)) if loss_arr is not None else None
+            gnorm = float(np.asarray(gnorm_arr)) if gnorm_arr is not None else None
+        except Exception:  # an undigestable metric must never kill training
+            return None
+        verdict, reason = self.sentinel.observe(loss, gnorm)
+        if verdict == "ok":
+            return None
+        if verdict == "warn":
+            return None  # below the streak window — keep counting quietly
+        return self._trip(reason, p_slot)
+
+    def _trip(self, reason: str, slot: int):
+        policy = self.handler.sentinel
+        step = self.accelerator.step
+        if policy == "warn":
+            if not self.sentinel.episode_warned:
+                self.sentinel.episode_warned = True
+                logger.warning(
+                    "fault_tolerance: divergence detected (%s; %d consecutive "
+                    "bad steps at step ~%d). Policy is 'warn' — training "
+                    "continues; consider sentinel='rollback'.",
+                    reason, self.sentinel.streak, step,
+                )
+                self._event(
+                    "divergence", step=step, reason=reason, policy="warn",
+                    streak=self.sentinel.streak,
+                )
+            self.sentinel.streak = 0  # re-arm: warn once per window, not per step
+            return None
+        if policy == "halt":
+            self._event(
+                "divergence", step=step, reason=reason, policy="halt",
+                streak=self.sentinel.streak,
+            )
+            raise DivergenceError(
+                f"training diverged ({reason}; {self.sentinel.streak} "
+                f"consecutive bad steps) — policy 'halt'. Restore a "
+                "checkpoint with load_state() or rerun with "
+                "sentinel='rollback'."
+            )
+        # policy == "rollback"
+        if self.rollbacks_done >= self.handler.max_rollbacks:
+            raise DivergenceError(
+                f"training diverged again ({reason}) after "
+                f"{self.rollbacks_done} rollback(s) — max_rollbacks "
+                f"({self.handler.max_rollbacks}) exhausted; the divergence is "
+                "reproducible from the checkpoint (bad data shard or LR "
+                "schedule?), not transient."
+            )
+        try:
+            restored = self.accelerator.load_state()
+        except FileNotFoundError as e:
+            raise DivergenceError(
+                f"training diverged ({reason}) and rollback found no "
+                f"verified checkpoint to restore: {e}"
+            ) from e
+        self.rollbacks_done += 1
+        self.sentinel.reset()
+        self._pending_metrics = None
+        new_state = self.accelerator._train_states[slot]
+        restored_step = int(np.asarray(new_state.step)) if new_state is not None else -1
+        logger.warning(
+            "fault_tolerance: divergence (%s) — rolled back to %s (step %d); "
+            "%d rollback(s) remaining.",
+            reason, restored, restored_step,
+            self.handler.max_rollbacks - self.rollbacks_done,
+        )
+        self._event(
+            "rollback", step=step, reason=reason, dir=restored,
+            restored_step=restored_step, rollbacks=self.rollbacks_done,
+        )
+        return new_state
+
+    def close(self) -> None:
+        self.uninstall_signal_handlers()
+        self._pending_metrics = None

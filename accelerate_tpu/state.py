@@ -236,6 +236,22 @@ class PartialState:
 
             multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
 
+    def agree_any(self, flag: bool) -> bool:
+        """Cross-rank OR of a host-side boolean: True everywhere as soon as
+        ANY rank passes True. One tiny int allreduce — the rank-coherence
+        primitive behind ``Accelerator.check_preemption()`` (only some hosts
+        of a pod get the scheduler's SIGTERM; the whole gang must take the
+        same save-and-exit decision) and ``check_trigger()``-style flags."""
+        if self.num_processes <= 1:
+            return bool(flag)
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .utils.operations import reduce
+
+        total = reduce(jnp.asarray(1 if flag else 0, jnp.int32), reduction="sum")
+        return int(np.asarray(total)) > 0
+
     @contextmanager
     def main_process_first(self):
         """Main process runs the body first, others wait then run

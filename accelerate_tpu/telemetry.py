@@ -143,6 +143,22 @@ class TelemetryRecorder:
         self._watch: dict[int, dict] = {}
         self.recompiles = 0
         self._checkpoint_events = 0
+        # Checkpoint-cost/robustness tally (fed by record_event; surfaced as
+        # the summary's "checkpoint" block so bench rows can track
+        # checkpoint-cost regressions and recovery actions across rounds).
+        self._ckpt = {
+            "saves": 0,
+            "loads": 0,
+            "save_s": 0.0,
+            "load_s": 0.0,
+            "verify_s": 0.0,
+            "retries": 0,
+            "torn_skipped": 0,
+            "preemption_saves": 0,
+            "rollbacks": 0,
+            "fallback_saves": 0,
+            "async_errors": 0,
+        }
         # Counters are process-global (utils/operations.py); a new recorder
         # means a new run's tally.
         collective_counters.reset()
@@ -387,9 +403,31 @@ class TelemetryRecorder:
             )
 
     def record_event(self, event: str, **fields):
-        """Out-of-band durations (checkpoint save/load, user phases)."""
+        """Out-of-band durations (checkpoint save/load, fault-tolerance
+        actions, user phases)."""
         if event in ("checkpoint_save", "checkpoint_load"):
             self._checkpoint_events += 1
+        ck = self._ckpt
+        if event == "checkpoint_save":
+            ck["saves"] += 1
+            ck["save_s"] += float(fields.get("seconds") or 0.0)
+        elif event == "checkpoint_load":
+            ck["loads"] += 1
+            ck["load_s"] += float(fields.get("seconds") or 0.0)
+        elif event == "checkpoint_verify":
+            ck["verify_s"] += float(fields.get("seconds") or 0.0)
+        elif event == "checkpoint_save_retry":
+            ck["retries"] += 1
+        elif event == "checkpoint_torn_skipped":
+            ck["torn_skipped"] += 1
+        elif event == "preemption_save":
+            ck["preemption_saves"] += 1
+        elif event == "rollback":
+            ck["rollbacks"] += 1
+        elif event == "checkpoint_fallback_save":
+            ck["fallback_saves"] += 1
+        elif event == "checkpoint_async_error":
+            ck["async_errors"] += 1
         record = {"event": event, "step": self.step, "time": time.time()}
         record.update(fields)
         self._write(record)
@@ -435,6 +473,13 @@ class TelemetryRecorder:
             "peak_hbm_bytes": self._peak_hbm,
             "collectives": collective_counters.snapshot(),
             "checkpoint_events": self._checkpoint_events,
+            # Checkpoint cost + fault-tolerance actions (save_s/verify_s/
+            # retries land in bench rows so checkpoint-cost regressions show
+            # up in the perf trajectory).
+            "checkpoint": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self._ckpt.items()
+            },
         }
         # Executable census: total dispatch-cache size across the watched
         # jitted fns — the number shape bucketing caps at len(buckets).

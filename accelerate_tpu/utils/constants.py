@@ -44,3 +44,17 @@ BATCH_AXES = ("dp_replicate", "dp_shard", "cp", "sp")
 ELASTIC_LOG_PREFIX = "[accelerate-tpu]"
 
 SCALER_NAME = "scaler"
+
+# Fault tolerance (fault_tolerance.py). An automatic checkpoint dir matches
+# CHECKPOINT_DIR_REGEX; anything else under <project>/checkpoints (stray user
+# dirs, interrupted ".tmp" staging dirs) is skipped by the load resolver and
+# the total_limit pruner. Atomic saves stage into "<final>" +
+# CHECKPOINT_STAGING_SUFFIX and rename on commit; CHECKPOINT_MANIFEST_NAME
+# inside a committed dir carries per-file sizes/checksums + step + world size.
+CHECKPOINT_DIR_REGEX = r"^checkpoint_(\d+)$"
+CHECKPOINT_STAGING_SUFFIX = ".tmp"
+CHECKPOINT_MANIFEST_NAME = "manifest.json"
+# Exit code a preemption-triggered save exits with (BSD EX_TEMPFAIL): the
+# launch gang loop treats it as "resumable — relaunch with
+# ACCELERATE_RESTART_ATTEMPT+1" instead of a crash.
+PREEMPTION_EXIT_CODE = 75

@@ -294,6 +294,76 @@ class TelemetryKwargs(KwargsHandler):
 
 
 @dataclass
+class FaultToleranceKwargs(KwargsHandler):
+    """Fault-tolerance config (fault_tolerance.py). Passing this handler to
+    ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it
+    ``accelerator.fault_tolerance`` is ``None``, every hook site is a single
+    ``None`` check, and the checkpoint byte layout is unchanged.
+
+    Four pillars (docs/usage_guides/fault_tolerance.md):
+
+    - **Atomic verified checkpoints**: every save writes into a
+      ``checkpoint_N.tmp`` staging dir, fsyncs, emits a ``manifest.json``
+      (per-file sizes + checksums + world size + step) and renames to
+      ``checkpoint_N`` as the commit point. ``load_state()`` walks
+      newest→oldest and restores the newest checkpoint whose manifest
+      verifies, skipping torn ones. ``total_limit`` pruning runs *after* the
+      commit, so a failed save can never destroy the only good checkpoint.
+      ``checksum``: ``"sha256"`` hashes every byte; ``"size"`` checks
+      existence + size only (for multi-TB checkpoints where hashing
+      dominates save time).
+    - **Preemption-aware auto-save**: SIGTERM/SIGUSR1 handlers installed at
+      ``prepare()`` set a flag the training loop observes via
+      ``accelerator.should_checkpoint()`` (local, free) or
+      ``accelerator.check_preemption()`` (collective — rank-coherent on
+      multi-host meshes). After the final save, exit with
+      ``utils.constants.PREEMPTION_EXIT_CODE`` — the launch gang loop treats
+      it as resumable and relaunches with ``ACCELERATE_RESTART_ATTEMPT`` set
+      so elastic auto-resume continues the run.
+    - **Save retry**: transient storage errors (OSError / TensorStore
+      failures) retry ``save_retries`` times with jittered exponential
+      backoff (``retry_backoff_s`` doubling up to ``retry_backoff_max_s``)
+      before falling back to ``fallback_dir`` when configured.
+    - **Divergence sentinel**: watches the step metrics (loss + grad norm,
+      fetched one step lagged so the watch never stalls async dispatch) for
+      ``sentinel_window`` consecutive nonfinite or exploding
+      (> ``sentinel_explode_factor`` × EMA) steps. Policy ``"warn"`` logs +
+      records the episode, ``"halt"`` raises :class:`DivergenceError`,
+      ``"rollback"`` restores the newest *verified* checkpoint (at most
+      ``max_rollbacks`` times) and re-primes RNG/dataloader state so the run
+      resumes deterministically. ``"off"`` disables the watch entirely.
+
+    All events (save retries, torn checkpoints skipped, preemption saves,
+    rollbacks) flow into the telemetry JSONL when a
+    :class:`TelemetryKwargs` handler is also present.
+    """
+
+    enabled: bool = True
+    atomic_checkpoints: bool = True
+    verify_on_load: bool = True
+    checksum: str = "sha256"  # sha256 | size
+    save_retries: int = 3
+    retry_backoff_s: float = 0.5
+    retry_backoff_max_s: float = 8.0
+    fallback_dir: Optional[str] = None
+    install_signal_handlers: bool = True
+    preemption_signals: tuple = ("SIGTERM", "SIGUSR1")
+    sentinel: str = "warn"  # off | warn | halt | rollback
+    sentinel_window: int = 3
+    sentinel_explode_factor: float = 10.0
+    sentinel_ema_alpha: float = 0.1
+    max_rollbacks: int = 2
+
+    def __post_init__(self):
+        if self.checksum not in ("sha256", "size"):
+            raise ValueError("checksum must be sha256|size")
+        if self.sentinel not in ("off", "warn", "halt", "rollback"):
+            raise ValueError("sentinel must be off|warn|halt|rollback")
+        if self.sentinel_window < 1:
+            raise ValueError("sentinel_window must be >= 1")
+
+
+@dataclass
 class CompileKwargs(KwargsHandler):
     """Compile-manager config (compile_manager.py). Passing this handler to
     ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it

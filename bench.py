@@ -326,6 +326,16 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 "executables",
             )
         }
+        # Checkpoint cost block (save_s, verify_s, retries, ... —
+        # telemetry.py summary): rows carry it so checkpoint-cost
+        # regressions show up in the perf trajectory alongside step times.
+        if t.get("checkpoint"):
+            ck = t["checkpoint"]
+            result["telemetry"]["checkpoint"] = {
+                k: ck.get(k)
+                for k in ("saves", "save_s", "verify_s", "retries",
+                          "torn_skipped", "rollbacks")
+            }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).
     _emit(round(r2k["tok_s"], 1), unit_2k("; seq-8192 pending"),
