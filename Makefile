@@ -6,7 +6,7 @@ PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
-        telemetry-smoke warmup-smoke faulttol-smoke
+        telemetry-smoke warmup-smoke faulttol-smoke serving-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -81,6 +81,14 @@ telemetry-smoke:
 # docs/usage_guides/performance.md "Taming recompiles".
 warmup-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.warmup_smoke
+
+# Continuous-batching gate: 32 mixed-length requests through a tiny Llama on
+# the CPU mesh must all complete with continuations bit-equal to static
+# generate(), keep the decode steady state at ONE executable (zero
+# post-warmup recompiles), and beat static-batch generate()'s aggregate
+# tokens/s on the same request set. See docs/usage_guides/serving.md.
+serving-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.serving_smoke
 
 # Fault-tolerance gate: SIGTERM a training worker mid-epoch (preemption
 # auto-save + resumable exit code), relaunch with ACCELERATE_RESTART_ATTEMPT=1

@@ -73,8 +73,11 @@ from .generation import (  # noqa: E402
     generate,
     speculative_generate,
     init_cache,
+    init_slot_cache,
     register_encdec_generation_plan,
     register_generation_plan,
     sample_logits,
 )
+from .serving import ServingEngine  # noqa: E402
+from .utils.dataclasses import ServingConfig  # noqa: E402
 from .cp_generation import cp_generate  # noqa: E402

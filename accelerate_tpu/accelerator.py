@@ -80,6 +80,7 @@ from .utils.dataclasses import (
     FaultToleranceKwargs,
     KwargsHandler,
     ProfileKwargs,
+    ServingConfig,
     TelemetryKwargs,
 )
 
@@ -195,6 +196,9 @@ class Accelerator:
         self.telemetry_handler = None
         self.compile_handler = None
         self.fault_tolerance_handler = None
+        # Serving config (serving.py): stored only — no serving code runs on
+        # the training path; build_serving_engine constructs the engine.
+        self.serving_config = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -210,6 +214,8 @@ class Accelerator:
                 self.compile_handler = handler
             elif isinstance(handler, FaultToleranceKwargs):
                 self.fault_tolerance_handler = handler
+            elif isinstance(handler, ServingConfig):
+                self.serving_config = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -1451,6 +1457,27 @@ class Accelerator:
         if self.compile_manager is None:
             return None
         return self.compile_manager.warmup()
+
+    def build_serving_engine(self, model, config: Optional[ServingConfig] = None):
+        """Construct a :class:`~accelerate_tpu.serving.ServingEngine` over
+        ``model`` (a prepared/loaded model with params on device), wired to
+        this Accelerator's compile manager (prefill-chunk ladder, generation
+        warmup) and telemetry recorder (serving block). ``config`` falls back
+        to the :class:`~accelerate_tpu.utils.ServingConfig` handler passed at
+        init; serving stays fully off — zero imports, zero hooks — without
+        one."""
+        cfg = config if config is not None else self.serving_config
+        if cfg is None or not cfg.enabled:
+            raise ValueError(
+                "serving is off: pass ServingConfig(...) here or in "
+                "Accelerator(kwargs_handlers=[...])."
+            )
+        from .serving import ServingEngine
+
+        return ServingEngine(
+            model, cfg,
+            compile_manager=self.compile_manager, telemetry=self.telemetry,
+        )
 
     def _comm_hook_step(
         self,

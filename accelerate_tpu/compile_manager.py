@@ -542,6 +542,92 @@ class CompileManager:
         if self.manifest is not None:
             self.manifest.record(digest, tree_to_spec(batch))
 
+    # -- generation signatures (decode loops) ------------------------------
+
+    def record_generation_signature(self, plan: str, batch: int, prompt_len: int,
+                                    max_new_tokens: int, settings: Optional[dict] = None) -> None:
+        """Record one ``generate()`` call signature (post-bucketing prompt
+        shape + sampling settings) so :meth:`warmup_generation` can compile
+        decode loops before the first request on a restart."""
+        settings = dict(settings or {})
+        digest = "gen:{}:{}x{}+{}:{}".format(
+            plan, int(batch), int(prompt_len), int(max_new_tokens),
+            "|".join(f"{k}={settings[k]}" for k in sorted(settings)),
+        )
+        if digest in self._seen:
+            return
+        self._seen.add(digest)
+        if self.manifest is not None:
+            spec = {
+                "kind": "generation", "plan": plan, "batch": int(batch),
+                "prompt_len": int(prompt_len),
+                "max_new_tokens": int(max_new_tokens), "settings": settings,
+            }
+            self.manifest.record(digest, spec)
+
+    def warmup_generation(self, model, generate_fn=None) -> int:
+        """Compile every recorded generation signature for ``model``'s plan
+        NOW (zero-filled dummy prompts through ``generate``) — the decode
+        analog of the train-step warmup. Returns the number of signatures
+        compiled; bad entries are skipped with a warning."""
+        if self.manifest is None:
+            return 0
+        if generate_fn is None:
+            from .generation import generate as generate_fn
+        plan = type(model.module).__name__
+        compiled = 0
+        t0 = time.perf_counter()
+        for entry in self.manifest.entries:
+            spec = entry.get("spec") or {}
+            if spec.get("kind") != "generation" or spec.get("plan") != plan:
+                continue
+            settings = spec.get("settings") or {}
+            try:
+                ids = np.zeros((spec["batch"], spec["prompt_len"]), np.int32)
+                kwargs = {
+                    k: settings.get(k)
+                    for k in ("temperature", "top_k", "top_p", "eos_token_id",
+                              "pad_token_id")
+                    if settings.get(k) is not None
+                }
+                if settings.get("masked"):
+                    kwargs["attention_mask"] = np.ones_like(ids)
+                generate_fn(model, ids, max_new_tokens=spec["max_new_tokens"],
+                            **kwargs)
+                compiled += 1
+            except Exception as e:  # warmup must never kill serving/inference
+                logger.warning(
+                    "compile_manager: generation warmup failed for %s: %s: %s",
+                    entry.get("digest", "?")[:80], type(e).__name__, e,
+                )
+        if compiled:
+            seconds = time.perf_counter() - t0
+            self.warmup_stats["signatures_compiled"] += compiled
+            self.warmup_stats["seconds"] += seconds
+            logger.info(
+                "compile_manager: warmed %d generation signature(s) in %.2fs "
+                "— the first request will not pay these compiles.",
+                compiled, seconds,
+            )
+        return compiled
+
+    def prefill_ladder(self, max_len: int, min_chunk: int = 16,
+                       max_chunk: int = 256) -> list:
+        """Chunk-size ladder for the serving engine's chunked prefill: the
+        handler's explicit seq buckets when the policy is ``fixed``, else
+        the pow2 ladder clipped to ``[min_chunk, min(max_chunk, max_len)]``
+        — so prefill executables and bucketed batch shapes share rungs."""
+        from .serving import default_prefill_ladder
+
+        h = self.handler
+        if h.buckets == "fixed" and h.seq_buckets:
+            rungs = sorted({int(x) for x in h.seq_buckets if int(x) <= max_len})
+            if rungs:
+                return rungs
+        lo = max(min_chunk, h.min_bucket)
+        hi = min(max_chunk, h.max_bucket) if h.max_bucket else max_chunk
+        return default_prefill_ladder(max_len, lo, max(lo, hi))
+
     # -- step registration + warmup ----------------------------------------
 
     def register_step(self, jitted, slot: int = 0, label: str = "train_step",
@@ -601,7 +687,13 @@ class CompileManager:
             return
         state = states[entry["slot"]]
         mode = self.handler.warmup
-        pending = [e for e in self.manifest.entries if e["digest"] not in entry["warmed"]]
+        pending = [
+            e for e in self.manifest.entries
+            if e["digest"] not in entry["warmed"]
+            # Generation signatures belong to warmup_generation (they need a
+            # model, not a train state).
+            and (e.get("spec") or {}).get("kind") != "generation"
+        ]
         if not pending:
             return
         t0 = time.perf_counter()

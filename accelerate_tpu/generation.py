@@ -46,7 +46,9 @@ from .utils.quantization import DecodeQuant, dequantize_decode_kernel
 class KVCache(NamedTuple):
     k: jax.Array  # (L, B, T_max, Hkv, D)
     v: jax.Array  # (L, B, T_max, Hkv, D)
-    length: jax.Array  # () int32 — tokens written so far
+    # () int32 — tokens written so far (batch-global), or (B,) int32 for a
+    # slot-paged cache (serving.py) where every row advances independently.
+    length: jax.Array
 
 
 def _cache_dims(cfg) -> tuple[int, int, int, int]:
@@ -78,6 +80,36 @@ def init_cache(cfg, batch: int, max_len: int, dtype=None) -> KVCache:
         k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
         length=jnp.zeros((), jnp.int32),
     )
+
+
+def init_slot_cache(cfg, n_slots: int, max_len: int, dtype=None) -> KVCache:
+    """Slot-paged cache (serving.py): same buffer layout as :func:`init_cache`
+    but ``length`` is a per-slot ``(n_slots,)`` vector, so every row advances
+    independently — one request retiring never stalls its neighbors."""
+    cache = init_cache(cfg, n_slots, max_len, dtype)
+    return cache._replace(length=jnp.zeros((n_slots,), jnp.int32))
+
+
+def _row_positions(start, b: int, s: int) -> jax.Array:
+    """(B, S) absolute cache positions for tokens appended at ``start`` —
+    a () scalar (batch-global cache) or a (B,) per-slot vector."""
+    offs = jnp.arange(s, dtype=jnp.int32)[None, :]
+    if getattr(start, "ndim", 0) == 1:
+        return start[:, None] + offs
+    return jnp.broadcast_to(start + offs, (b, s))
+
+
+def _cache_write(ck, k_new, start):
+    """Write ``k_new`` (B, S, Hkv, D) into the cache slice ``ck``
+    (B, T, Hkv, D) at row offset ``start`` — a scalar (one contiguous
+    ``dynamic_update_slice``) or per-row vector (scatter at each row's own
+    offset, the slot-paged path)."""
+    if getattr(start, "ndim", 0) == 1:
+        b, s = k_new.shape[:2]
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+        cols = start[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        return ck.at[rows, cols].set(k_new)
+    return jax.lax.dynamic_update_slice(ck, k_new, (0, start, 0, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -208,8 +240,7 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
     b, s = input_ids.shape
     t_max = cache.k.shape[2]
     start = cache.length
-    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (b, s))
+    positions = _row_positions(start, b, s)
 
     x = _embed_tokens(cfg, embed, input_ids)
     rope_positions = positions
@@ -229,8 +260,8 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         q, k_new, v_new = _qkv_proj(attn, hn, cos, sin, rotary_dim=rd)
         if attn_mult is not None:  # same q-folding trick as LlamaAttention
             q = q * jnp.asarray(attn_mult * np.sqrt(cfg.head_dim), q.dtype)
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
+        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
         out = _attend(q, ck, cv, positions, kv_valid)
         out = _out_proj(out, attn["o_proj"]["kernel"])
         if "bias" in attn["o_proj"]:
@@ -304,8 +335,7 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
     b, s = input_ids.shape
     t_max = cache.k.shape[2]
     start = cache.length
-    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
-    positions_b = jnp.broadcast_to(positions, (b, s))
+    positions_b = _row_positions(start, b, s)
     pos_ids = positions_b
     if pad_offset is not None:
         pos_ids = jnp.maximum(positions_b - pad_offset[:, None], 0)
@@ -321,8 +351,8 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
             "bsh,hcnd->bscnd", hn, p["attn"]["c_attn"]["kernel"].astype(hn.dtype)
         ) + p["attn"]["c_attn"]["bias"].astype(hn.dtype)
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
+        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + (
             jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["c_proj"]["kernel"].astype(out.dtype))
@@ -354,8 +384,7 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
 
     b, s = input_ids.shape
     start = cache.length
-    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
-    positions_b = jnp.broadcast_to(positions, (b, s))
+    positions_b = _row_positions(start, b, s)
     pos_ids = positions_b
     if pad_offset is not None:
         pos_ids = jnp.maximum(positions_b - pad_offset[:, None], 0)
@@ -373,8 +402,8 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
         q = _proj(hn, attn["q_proj"]["kernel"]) + attn["q_proj"]["bias"].astype(hn.dtype)
         k_new = _proj(hn, attn["k_proj"]["kernel"]) + attn["k_proj"]["bias"].astype(hn.dtype)
         v_new = _proj(hn, attn["v_proj"]["kernel"]) + attn["v_proj"]["bias"].astype(hn.dtype)
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
+        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + _out_proj(out, attn["out_proj"]["kernel"]) + attn["out_proj"]["bias"].astype(h.dtype)
         hn = _layer_norm(h, p["final_layer_norm"], cfg.layer_norm_eps)
@@ -402,8 +431,7 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
 
     b, s = input_ids.shape
     start = cache.length
-    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
-    positions_b = jnp.broadcast_to(positions, (b, s))
+    positions_b = _row_positions(start, b, s)
     rope_positions = positions_b
     if pad_offset is not None:
         rope_positions = jnp.maximum(positions_b - pad_offset[:, None], 0)
@@ -423,8 +451,8 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
         q, k_new, v_new = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         q = jnp.concatenate([apply_rope(q[..., :rnd], cos, sin), q[..., rnd:]], -1)
         k_new = jnp.concatenate([apply_rope(k_new[..., :rnd], cos, sin), k_new[..., rnd:]], -1)
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
+        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         attn_out = (
             jnp.einsum("bsnd,ndh->bsh", out, attn["dense"]["kernel"].astype(out.dtype))
@@ -470,8 +498,7 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
 
     b, s = input_ids.shape
     start = cache.length
-    positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
-    positions = jnp.broadcast_to(positions, (b, s))
+    positions = _row_positions(start, b, s)
     rope_positions = positions
     if pad_offset is not None:
         rope_positions = jnp.maximum(positions - pad_offset[:, None], 0)
@@ -506,8 +533,8 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
         attn = p["self_attn"]
         hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
         q, k_new, v_new = _qkv_proj(attn, hn, cos, sin)
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype), (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype), (0, start, 0, 0))
+        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
+        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
         out = _attend(q, ck, cv, positions, kv_valid)
         h = h + _out_proj(out, attn["o_proj"]["kernel"])
         hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
@@ -862,6 +889,8 @@ def generate(
     suppress_tokens=None,
     begin_suppress_tokens=None,
     forced_decoder_ids=None,
+    seq_buckets=None,
+    compile_manager=None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for ``input_ids`` (B, S).
 
@@ -881,6 +910,16 @@ def generate(
     loop starts from ``decoder_input_ids`` (default: one
     ``decoder_start_token_id`` per row — pass Whisper's forced SOT prompt
     here). Returns the decoder sequence (B, S_dec + max_new_tokens).
+
+    ``seq_buckets`` / ``compile_manager`` (opt-in): round the prompt length
+    up a bucket ladder (explicit rungs, or the compile manager's seq policy)
+    by LEFT-padding — varied prompt lengths then share ≤ ``len(buckets)``
+    compiled prefills instead of minting one executable per length. Output
+    shape and tokens are unchanged (left-padding is masked out exactly like
+    a padded batch). With a ``compile_manager``, the call's signature is also
+    recorded in the shapes manifest so
+    :meth:`~accelerate_tpu.compile_manager.CompileManager.warmup_generation`
+    can pre-compile decode loops on the next run.
     """
     gc = config or GenerationConfig()
     max_new_tokens = gc.max_new_tokens if max_new_tokens is None else max_new_tokens
@@ -920,7 +959,31 @@ def generate(
             f"No generation plan for {type(model.module).__name__!r}; built-in: {known}"
         )
     input_ids = jnp.asarray(input_ids)
+    orig_input_ids = input_ids
     b, s = input_ids.shape
+    mask_np = None
+    if attention_mask is not None:
+        # Host-side mask arithmetic throughout: the validation below and the
+        # pad_offset/kv_valid derivations used to run on device, costing a
+        # blocking sync (`bool(jnp.all(...))`) on every call.
+        mask_np = np.asarray(attention_mask, np.int32)
+
+    # Opt-in prompt bucketing: round s up the ladder by LEFT-padding (masked
+    # pads are invisible — same machinery as a padded batch), so a stream of
+    # varied prompt lengths reuses <= len(buckets) compiled prefills.
+    if (seq_buckets or compile_manager is not None) and enc_state is None:
+        s_b = _bucketed_prompt_len(s, seq_buckets, compile_manager)
+        if s_b > s:
+            fill = pad_token_id if pad_token_id is not None else 0
+            pad_block = jnp.full((b, s_b - s), fill, input_ids.dtype)
+            input_ids = jnp.concatenate([pad_block, input_ids], axis=1)
+            if mask_np is None:
+                mask_np = np.ones((b, s), np.int32)
+            mask_np = np.concatenate(
+                [np.zeros((b, s_b - s), np.int32), mask_np], axis=1
+            )
+            s = s_b
+
     t_max = s + max_new_tokens
     max_pos = _cache_dims(cfg)[3]
     if t_max > max_pos:
@@ -930,7 +993,7 @@ def generate(
     rng = rng if rng is not None else jax.random.key(0)
 
     pad_offset = kv_valid = None
-    if attention_mask is not None:
+    if mask_np is not None:
         import inspect
 
         if "pad_offset" not in inspect.signature(fwd).parameters:
@@ -940,25 +1003,42 @@ def generate(
                 "encoder mask from pad_token_id automatically; custom plans "
                 "need pad_offset/kv_valid parameters to support padded batches."
             )
-        mask = jnp.asarray(attention_mask, jnp.int32)
-        pad_offset = jnp.argmax(mask, axis=1).astype(jnp.int32)  # leading pads per row
+        off_np = np.argmax(mask_np, axis=1).astype(np.int32)  # leading pads per row
         # Decoder-only generation requires LEFT padding (transformers warns
         # about the same mistake): right/ragged masks would silently read the
         # next-token logits off a pad-position query.
-        if not bool(jnp.all(pad_offset + mask.sum(axis=1) == s)):
+        if not np.all(off_np + mask_np.sum(axis=1) == s):
             raise ValueError(
                 "attention_mask must be left-padded (zeros then ones per row) "
                 "for decoder-only generation; got a right-padded or "
                 "non-contiguous mask. Re-tokenize with padding_side='left'."
             )
-        kv_valid = jnp.concatenate(
-            [mask.astype(bool), jnp.ones((b, t_max - s), bool)], axis=1
+        pad_offset = jnp.asarray(off_np)
+        kv_valid = jnp.asarray(
+            np.concatenate(
+                [mask_np.astype(bool), np.ones((b, t_max - s), bool)], axis=1
+            )
         )
+
+    if compile_manager is not None:
+        # Generation signatures land in the shapes manifest too, so AOT
+        # warmup (warmup_generation) covers decode loops across runs.
+        try:
+            compile_manager.record_generation_signature(
+                type(model.module).__name__, b, s, max_new_tokens,
+                settings={
+                    "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                    "eos_token_id": eos_token_id, "pad_token_id": pad_token_id,
+                    "masked": mask_np is not None,
+                },
+            )
+        except Exception:  # manifest trouble must never block generation
+            pass
 
     loop = _generation_loop(
         fwd, cfg, max_new_tokens, temperature, top_k, top_p,
         eos_token_id, pad_token_id,
-        masked=attention_mask is not None, encdec=enc_state is not None,
+        masked=mask_np is not None, encdec=enc_state is not None,
         suppress=tuple(suppress_tokens) if suppress_tokens else None,
         begin_suppress=tuple(begin_suppress_tokens) if begin_suppress_tokens else None,
         forced=tuple(tuple(f) for f in forced_decoder_ids) if forced_decoder_ids else None,
@@ -966,7 +1046,23 @@ def generate(
     )
     cache = init_cache(cfg, b, t_max)
     toks = loop(params, input_ids, cache, rng, pad_offset, kv_valid, enc_state)
-    return jnp.concatenate([input_ids, toks.T.astype(input_ids.dtype)], axis=1)
+    # Bucketing pads on the LEFT; the returned sequence keeps the caller's
+    # original prompt columns, so the output shape never changes.
+    return jnp.concatenate([orig_input_ids, toks.T.astype(orig_input_ids.dtype)], axis=1)
+
+
+def _bucketed_prompt_len(s: int, seq_buckets, compile_manager) -> int:
+    """Prompt length rounded up the bucket ladder: explicit ``seq_buckets``
+    rungs win, else the compile manager's seq policy. Off-ladder lengths fall
+    through at their true size (same contract as ``bucket_for``)."""
+    if seq_buckets:
+        from .compile_manager import ladder_bucket
+
+        bucketed = ladder_bucket(s, seq_buckets)
+        return int(bucketed) if bucketed is not None else s
+    if compile_manager is not None:
+        return int(compile_manager.bucket_for(s, "seq"))
+    return s
 
 
 _GEN_LOOP_CACHE: dict = {}

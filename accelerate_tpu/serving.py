@@ -1,0 +1,621 @@
+"""Continuous-batching serving engine (layer L7 — inference serving).
+
+:func:`~accelerate_tpu.generation.generate` is a gang-scheduled static
+batch: one compiled loop per ``(batch, prompt_len, max_new_tokens)`` tuple, a
+batch-global cache ``length`` scalar, and every request stalls until the
+slowest row finishes. Under mixed-length traffic most of the chip burns on
+finished rows and every new prompt shape recompiles. This module is the
+vLLM/TGI-class fix, TPU-shaped:
+
+- **Slot-paged KV cache** — ONE ``(L, n_slots, T_max, Hkv, D)`` buffer pair
+  (generation.py :func:`init_slot_cache`) whose ``length`` is a per-slot
+  vector; a request occupies a slot for exactly its own lifetime and the
+  slot is reused mid-flight with no reshape and no recompile.
+- **Admission scheduler** — incoming requests queue; free slots fill every
+  tick; rows that emit EOS (or exhaust their budget) retire immediately and
+  hand their slot to the next request.
+- **Chunked prefill** — prompts are split into ladder-sized chunks (the
+  compile manager's seq buckets when available) and written a chunk per
+  tick, so a long prompt never head-of-line-blocks decode latency and every
+  possible prompt length compiles at most ``len(ladder)`` prefill
+  executables.
+- **Zero-recompile decode** — the steady-state decode step is ONE jitted
+  ``(params, cache, slot_state) -> (cache, slot_state, tokens)`` program
+  with donated cache buffers; its executable count is watched every tick
+  (``stats()["steady_recompiles"]``, cross-checked by the telemetry
+  recompile watchdog when a recorder is attached).
+
+Greedy decoding through the engine is token-for-token identical to
+:func:`generate` per request (tests/test_serving.py pins it); sampled
+decoding uses one PRNG stream per request (the ``rng`` passed at
+``submit``), mirroring a batch-1 ``generate`` call.
+
+Off by default everywhere: no engine exists unless you construct one (or
+pass a :class:`~accelerate_tpu.utils.ServingConfig` to
+``Accelerator.build_serving_engine``), and the training path never touches
+this module.
+
+Usage::
+
+    from accelerate_tpu import ServingConfig, ServingEngine
+
+    engine = ServingEngine(model, ServingConfig(n_slots=8, eos_token_id=2))
+    # Batch API:
+    outs = engine.run(prompts, max_new_tokens=64)
+    # Incremental API (a serving front-end's loop):
+    rid = engine.submit(prompt, max_new_tokens=64)
+    while True:
+        engine.tick()
+        for res in engine.poll():
+            ...  # res["tokens"] is the full prompt+continuation row
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generation import (
+    ENCDEC_GENERATION_PLANS,
+    GENERATION_PLANS,
+    KVCache,
+    _cache_dims,
+    init_slot_cache,
+    sample_logits,
+)
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Chunk-ladder math (pure functions — unit-tested directly)
+# ---------------------------------------------------------------------------
+
+
+def default_prefill_ladder(max_len: int, min_chunk: int = 16,
+                           max_chunk: int = 256) -> list[int]:
+    """Pow2 chunk ladder for chunked prefill: ``min_chunk`` doubling up to
+    ``min(max_chunk, max_len)``. Arbitrary prompt lengths then compile at
+    most ``len(ladder)`` prefill executables."""
+    top = max(1, min(int(max_chunk), int(max_len)))
+    rungs, c = set(), max(1, int(min_chunk))
+    while c < top:
+        rungs.add(c)
+        c *= 2
+    rungs.add(top)
+    return sorted(rungs)
+
+
+def plan_chunks(prompt_len: int, ladder) -> list[tuple[int, int]]:
+    """Split a prompt into ``(chunk_size, valid_tokens)`` pieces: greedy
+    largest-rung-that-fits; the final partial piece pads up to the smallest
+    rung that covers it (pad slots are never attended — the causal mask
+    bounds attention at each row's true length, and the next write
+    overwrites them)."""
+    rungs = sorted({int(x) for x in ladder})
+    if not rungs or prompt_len < 1:
+        raise ValueError(f"need a non-empty ladder and prompt, got "
+                         f"ladder={rungs} prompt_len={prompt_len}")
+    out, rem = [], int(prompt_len)
+    while rem > 0:
+        fits = [r for r in rungs if r <= rem]
+        if fits:
+            out.append((fits[-1], fits[-1]))
+            rem -= fits[-1]
+        else:  # tail shorter than every rung: pad up to the smallest
+            out.append((rungs[0], rem))
+            rem = 0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side slot state
+# ---------------------------------------------------------------------------
+
+
+class SlotState(NamedTuple):
+    """Per-slot decode state — the vectors that replace ``generate()``'s
+    batch-global scalars. Threaded (donated) through the jitted decode step
+    alongside the slot cache."""
+
+    last_token: jax.Array  # (N,) int32 — most recent sampled token per slot
+    active: jax.Array      # (N,) bool  — prompt fully prefilled, decoding
+    done: jax.Array        # (N,) bool  — emitted EOS / exhausted budget
+    generated: jax.Array   # (N,) int32 — new tokens sampled so far
+    budget: jax.Array      # (N,) int32 — per-request max_new_tokens
+    rng: jax.Array         # (N,) PRNG keys — one stream per request
+
+
+def init_slot_state(n_slots: int, seed: int = 0) -> SlotState:
+    return SlotState(
+        last_token=jnp.zeros((n_slots,), jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+        done=jnp.zeros((n_slots,), bool),
+        generated=jnp.zeros((n_slots,), jnp.int32),
+        budget=jnp.zeros((n_slots,), jnp.int32),
+        rng=jax.random.split(jax.random.key(seed), n_slots),
+    )
+
+
+def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
+    """ONE jitted decode program for the whole engine lifetime: every slot
+    advances one token (rows that are free or done compute masked garbage —
+    the fixed shape is what buys zero steady-state recompiles). Cache and
+    state buffers are donated."""
+
+    def decode(params, cache: KVCache, state: SlotState):
+        live = state.active & ~state.done
+        logits, new_cache = fwd(cfg, params, state.last_token[:, None], cache)
+        # fwd advanced every row's write offset; only live rows really did.
+        lengths = jnp.where(live, new_cache.length, cache.length)
+        pairs = jax.vmap(jax.random.split)(state.rng)  # (N, 2) keys
+        carry, sub = pairs[:, 0], pairs[:, 1]
+        # Per-slot sampling over a (1, V) row — the same shape a batch-1
+        # generate() samples, so per-request streams match it exactly.
+        tok = jax.vmap(
+            lambda row, key: sample_logits(
+                row[None], key, temperature=temperature, top_k=top_k, top_p=top_p
+            )[0]
+        )(logits, sub)
+        tok = jnp.where(live, tok, state.last_token)
+        generated = state.generated + live.astype(jnp.int32)
+        newly_done = live & (generated >= state.budget)
+        if eos_token_id is not None:
+            newly_done = newly_done | (live & (tok == eos_token_id))
+        new_state = SlotState(
+            last_token=tok,
+            active=state.active,
+            done=state.done | newly_done,
+            generated=generated,
+            budget=state.budget,
+            # Free/done slots' streams are dead until realloc rewrites them,
+            # so advancing every row keeps the update shape-uniform.
+            rng=carry,
+        )
+        return KVCache(new_cache.k, new_cache.v, lengths), new_state, tok
+
+    return jax.jit(decode, donate_argnums=(1, 2))
+
+
+def _build_prefill_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
+    """One jitted prefill program; each ladder chunk size is one executable
+    inside it. Writes a ``(1, C)`` prompt chunk into ``slot`` at that slot's
+    own offset; on the final chunk it samples the request's first token
+    (TTFT) and arms the slot for decode."""
+
+    def prefill(params, cache: KVCache, state: SlotState, chunk, slot, valid,
+                budget, rng, is_first, is_final):
+        start = jnp.where(is_first, 0, cache.length[slot])
+        sub_cache = KVCache(
+            jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+            start[None],  # (1,) per-row vector — the slot-paged fwd path
+        )
+        logits_all, sub_cache = fwd(cfg, params, chunk, sub_cache, return_all=True)
+        k = jax.lax.dynamic_update_slice_in_dim(cache.k, sub_cache.k, slot, axis=1)
+        v = jax.lax.dynamic_update_slice_in_dim(cache.v, sub_cache.v, slot, axis=1)
+        # Advance by the VALID tokens only; a padded tail is overwritten by
+        # the next write and never attended (causal bound at true length).
+        lengths = cache.length.at[slot].set(start + valid)
+
+        carry, sub_key = jax.random.split(rng)
+        last = logits_all[0, valid - 1]  # the last REAL prompt position
+        tok = sample_logits(
+            last[None], sub_key, temperature=temperature, top_k=top_k, top_p=top_p
+        )[0]
+        done0 = budget <= 1
+        if eos_token_id is not None:
+            done0 = done0 | (tok == eos_token_id)
+        done0 = is_final & done0
+        new_state = SlotState(
+            # Intermediate chunks park a garbage token here; the final chunk
+            # (the only one decode can observe — active stays False until
+            # then) overwrites it with the real first token.
+            last_token=state.last_token.at[slot].set(tok),
+            active=state.active.at[slot].set(is_final),
+            done=state.done.at[slot].set(done0),
+            generated=state.generated.at[slot].set(
+                jnp.where(is_final, 1, 0).astype(jnp.int32)),
+            budget=state.budget.at[slot].set(budget),
+            rng=state.rng.at[slot].set(carry),
+        )
+        return KVCache(k, v, lengths), new_state, tok, done0
+
+    return jax.jit(prefill, donate_argnums=(1, 2))
+
+
+def _cache_size(fn) -> Optional[int]:
+    size_fn = getattr(fn, "_cache_size", None)
+    if callable(size_fn):
+        try:
+            return int(size_fn())
+        except Exception:
+            return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Host-side request bookkeeping
+# ---------------------------------------------------------------------------
+
+
+class _Request:
+    __slots__ = (
+        "id", "tokens", "budget", "rng", "slot", "chunks", "next_chunk",
+        "consumed", "out", "submit_t", "first_token_t", "done_t",
+    )
+
+    def __init__(self, rid, tokens, budget, rng):
+        self.id = rid
+        self.tokens = tokens          # np.int32 1-D prompt
+        self.budget = budget
+        self.rng = rng
+        self.slot = None
+        self.chunks = None            # [(chunk_size, valid)] once admitted
+        self.next_chunk = 0
+        self.consumed = 0             # prompt tokens already in the cache
+        self.out: list[int] = []      # sampled continuation (incl. EOS)
+        self.submit_t = time.perf_counter()
+        self.first_token_t = None
+        self.done_t = None
+
+
+class ServingEngine:
+    """Continuous-batching inference over one model.
+
+    Built from a model with params on device (the object
+    :func:`~accelerate_tpu.generation.generate` takes) and a
+    :class:`~accelerate_tpu.utils.ServingConfig`; or from any custom
+    generation plan via ``forward_cached`` (the registry contract:
+    ``fwd(cfg, params, ids, cache, return_all=False)``). Pass
+    ``compile_manager`` to source the prefill ladder from its seq-bucket
+    policy, and ``telemetry`` to stream per-request TTFT/TPOT events and the
+    serving summary into the PR-1 recorder.
+    """
+
+    def __init__(self, model, config=None, *, forward_cached: Optional[Callable] = None,
+                 compile_manager=None, telemetry=None):
+        from .utils.dataclasses import ServingConfig
+
+        self.config = config if config is not None else ServingConfig()
+        self.model = model
+        self.telemetry = telemetry
+        name = type(model.module).__name__
+        if forward_cached is not None:
+            fwd = forward_cached
+        else:
+            if name in ENCDEC_GENERATION_PLANS:
+                raise ValueError(
+                    "ServingEngine serves causal-LM plans; encoder-decoder "
+                    f"families ({name}) keep the static generate() path."
+                )
+            fwd = GENERATION_PLANS.get(name)
+            if fwd is None:
+                known = ", ".join(sorted(GENERATION_PLANS))
+                raise ValueError(f"No generation plan for {name!r}; built-in: {known}")
+        self._fwd = fwd
+        self.cfg = model.module.config
+
+        c = self.config
+        self.n_slots = int(c.n_slots)
+        max_pos = _cache_dims(self.cfg)[3]
+        self.t_max = int(c.max_len) if c.max_len else int(min(max_pos, 4096))
+        if self.t_max > max_pos:
+            raise ValueError(
+                f"ServingConfig.max_len={self.t_max} exceeds "
+                f"max_position_embeddings={max_pos}"
+            )
+        if c.prefill_chunks:
+            ladder = sorted({int(x) for x in c.prefill_chunks})
+        elif compile_manager is not None:
+            ladder = compile_manager.prefill_ladder(
+                self.t_max, min_chunk=c.min_prefill_chunk,
+                max_chunk=c.max_prefill_chunk,
+            )
+        else:
+            ladder = default_prefill_ladder(
+                self.t_max, c.min_prefill_chunk, c.max_prefill_chunk
+            )
+        self.ladder = [r for r in ladder if r <= self.t_max] or [self.t_max]
+
+        eos = c.eos_token_id
+        self.pad_token_id = c.pad_token_id if c.pad_token_id is not None else (
+            eos if eos is not None else 0
+        )
+        self._decode = _build_decode_step(
+            fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos
+        )
+        self._prefill = _build_prefill_step(
+            fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos
+        )
+        self._cache = init_slot_cache(
+            self.cfg, self.n_slots, self.t_max, dtype=c.cache_dtype
+        )
+        self._state = init_slot_state(self.n_slots, seed=c.seed)
+
+        self._queue: deque[_Request] = deque()
+        self._prefilling: deque[_Request] = deque()
+        self._decoding: dict[int, _Request] = {}
+        self._free: list[int] = list(range(self.n_slots - 1, -1, -1))
+        self._used_slots: set[int] = set()
+        self._finished: deque[dict] = deque()
+        self._ids = itertools.count()
+        self._decode_executables_baseline: Optional[int] = None
+        self._first_submit_t: Optional[float] = None
+        self._last_done_t: Optional[float] = None
+        self._ttfts: list[float] = []
+        self._tpots: list[float] = []
+        self._stats = {
+            "submitted": 0, "completed": 0, "ticks": 0, "decode_steps": 0,
+            "prefill_chunks": 0, "prefill_pad_tokens": 0, "tokens_out": 0,
+            "slot_allocs": 0, "slot_reuses": 0, "occupancy_sum": 0,
+            "peak_occupancy": 0, "queue_depth_sum": 0, "queue_samples": 0,
+            "steady_recompiles": 0,
+        }
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               rng: Optional[jax.Array] = None) -> int:
+        """Queue one request; returns its id. ``prompt`` is a 1-D token id
+        sequence; ``rng`` seeds this request's private sampling stream
+        (default ``jax.random.key(0)`` — generate()'s default)."""
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("empty prompt")
+        budget = int(max_new_tokens if max_new_tokens is not None
+                     else self.config.max_new_tokens)
+        if budget < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {budget}")
+        if int(tokens.size) + budget > self.t_max:
+            raise ValueError(
+                f"prompt ({tokens.size}) + max_new_tokens ({budget}) exceeds "
+                f"the slot capacity T_max={self.t_max}; raise "
+                "ServingConfig.max_len."
+            )
+        req = _Request(next(self._ids), tokens, budget,
+                       rng if rng is not None else jax.random.key(0))
+        self._queue.append(req)
+        self._stats["submitted"] += 1
+        if self._first_submit_t is None:
+            self._first_submit_t = req.submit_t
+        return req.id
+
+    def poll(self) -> list[dict]:
+        """Results finished since the last poll: ``{"id", "tokens",
+        "new_tokens", "ttft_s", "tpot_s"}`` — ``tokens`` is the full
+        prompt+continuation row padded to ``prompt+budget`` with
+        ``pad_token_id`` (generate()'s row layout)."""
+        out = list(self._finished)
+        self._finished.clear()
+        return out
+
+    @property
+    def pending(self) -> int:
+        """Requests not yet delivered (queued + prefilling + decoding)."""
+        return len(self._queue) + len(self._prefilling) + len(self._decoding)
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self) -> None:
+        """One scheduler round: admit into free slots, advance one prompt
+        chunk (up to ``prefill_chunks_per_tick``), then one decode step for
+        every live slot."""
+        self._admit()
+        self._stats["queue_depth_sum"] += len(self._queue)
+        self._stats["queue_samples"] += 1
+        for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
+            if not self._prefilling:
+                break
+            self._prefill_one(self._prefilling[0])
+        if self._decoding:
+            self._decode_tick()
+        self._stats["ticks"] += 1
+
+    def _admit(self) -> None:
+        while self._free and self._queue:
+            req = self._queue.popleft()
+            slot = self._free.pop()
+            req.slot = slot
+            req.chunks = plan_chunks(int(req.tokens.size), self.ladder)
+            self._stats["slot_allocs"] += 1
+            if slot in self._used_slots:
+                self._stats["slot_reuses"] += 1
+            self._used_slots.add(slot)
+            self._prefilling.append(req)
+
+    def _prefill_one(self, req: _Request) -> None:
+        size, valid = req.chunks[req.next_chunk]
+        chunk = np.zeros((1, size), np.int32)
+        chunk[0, :valid] = req.tokens[req.consumed:req.consumed + valid]
+        is_first = req.next_chunk == 0
+        is_final = req.next_chunk == len(req.chunks) - 1
+        self._cache, self._state, tok, done0 = self._prefill(
+            self.model.params, self._cache, self._state, chunk,
+            np.int32(req.slot), np.int32(valid), np.int32(req.budget),
+            req.rng, is_first, is_final,
+        )
+        req.next_chunk += 1
+        req.consumed += valid
+        self._stats["prefill_chunks"] += 1
+        self._stats["prefill_pad_tokens"] += size - valid
+        if is_final:
+            self._prefilling.popleft()
+            req.first_token_t = time.perf_counter()
+            req.out.append(int(tok))  # small host fetch — the TTFT moment
+            if bool(done0):
+                self._retire(req)
+            else:
+                self._decoding[req.slot] = req
+
+    def _decode_tick(self) -> None:
+        self._cache, self._state, tok = self._decode(
+            self.model.params, self._cache, self._state
+        )
+        live = len(self._decoding)
+        self._stats["decode_steps"] += 1
+        if self.telemetry is not None:
+            # PR-1 recompile-watchdog cross-check: sample the decode step's
+            # executable cache exactly like a train step's — any mid-flight
+            # growth lands as a "recompile" event in the telemetry JSONL.
+            try:
+                self.telemetry._watch_recompiles(self._decode, tok)
+            except Exception:
+                pass
+        self._stats["occupancy_sum"] += live
+        self._stats["peak_occupancy"] = max(self._stats["peak_occupancy"], live)
+        size = _cache_size(self._decode)
+        if size is not None:
+            if self._decode_executables_baseline is None:
+                self._decode_executables_baseline = size
+            elif size > self._decode_executables_baseline:
+                extra = size - self._decode_executables_baseline
+                self._stats["steady_recompiles"] += extra
+                self._decode_executables_baseline = size
+                logger.warning(
+                    "serving: decode step recompiled mid-flight (%d extra "
+                    "executable(s)) — the steady state should be exactly one "
+                    "program; see docs/usage_guides/serving.md.", extra,
+                )
+        # The per-tick host sync: fetch this round's tokens + done flags.
+        tok_np, done_np = jax.device_get((tok, self._state.done))
+        for slot, req in list(self._decoding.items()):
+            req.out.append(int(tok_np[slot]))
+            if bool(done_np[slot]):
+                del self._decoding[slot]
+                self._retire(req)
+
+    def _retire(self, req: _Request) -> None:
+        req.done_t = time.perf_counter()
+        self._last_done_t = req.done_t
+        self._free.append(req.slot)
+        n_new = len(req.out)
+        row = np.concatenate([
+            req.tokens,
+            np.asarray(req.out, np.int32),
+            np.full((req.budget - n_new,), self.pad_token_id, np.int32),
+        ])
+        ttft = req.first_token_t - req.submit_t
+        tpot = ((req.done_t - req.first_token_t) / (n_new - 1)) if n_new > 1 else 0.0
+        self._ttfts.append(ttft)
+        self._tpots.append(tpot)
+        self._stats["completed"] += 1
+        self._stats["tokens_out"] += n_new
+        self._finished.append({
+            "id": req.id, "tokens": row, "new_tokens": n_new,
+            "ttft_s": ttft, "tpot_s": tpot,
+        })
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "serving_request_done", request_id=req.id, ttft_s=ttft,
+                tpot_s=tpot, new_tokens=n_new,
+                prompt_tokens=int(req.tokens.size), slot=req.slot,
+            )
+
+    # -- batch front-end ---------------------------------------------------
+
+    def run(self, prompts, max_new_tokens: Optional[int] = None,
+            rngs=None, max_ticks: Optional[int] = None) -> list[np.ndarray]:
+        """Synchronous batch API: submit every prompt, tick until drained,
+        return one full ``prompt+continuation`` row per prompt in input
+        order. ``max_new_tokens`` may be an int or a per-request list;
+        ``rngs`` a per-request list of PRNG keys."""
+        n = len(prompts)
+        budgets = (max_new_tokens if isinstance(max_new_tokens, (list, tuple))
+                   else [max_new_tokens] * n)
+        keys = rngs if rngs is not None else [None] * n
+        ids = [self.submit(p, max_new_tokens=budgets[i], rng=keys[i])
+               for i, p in enumerate(prompts)]
+        results: dict[int, np.ndarray] = {}
+        budget_guard = max_ticks if max_ticks is not None else (
+            10 * (sum(len(plan_chunks(len(np.ravel(p)), self.ladder)) for p in prompts)
+                  + sum(int(b or self.config.max_new_tokens) for b in budgets))
+            + 100
+        )
+        ticks = 0
+        while self.pending:
+            self.tick()
+            for res in self.poll():
+                results[res["id"]] = res["tokens"]
+            ticks += 1
+            if ticks > budget_guard:
+                raise RuntimeError(
+                    f"serving engine failed to drain in {budget_guard} ticks "
+                    f"({self.pending} requests still pending)"
+                )
+        self._push_telemetry_summary()
+        return [results[i] for i in ids]
+
+    # -- reporting ---------------------------------------------------------
+
+    def executable_counts(self) -> dict:
+        """Dispatch-cache sizes of the two jitted programs — the numbers the
+        zero-recompile acceptance bar constrains (decode: exactly 1;
+        prefill: <= len(ladder))."""
+        return {
+            "decode": _cache_size(self._decode),
+            "prefill": _cache_size(self._prefill),
+        }
+
+    def stats(self) -> dict:
+        """The serving telemetry block: TTFT/TPOT percentiles, queue depth,
+        slot occupancy, aggregate tokens/s, executable census."""
+        s = dict(self._stats)
+        execs = self.executable_counts()
+        elapsed = None
+        if self._first_submit_t is not None:
+            elapsed = (self._last_done_t or time.perf_counter()) - self._first_submit_t
+        ttft = np.asarray(self._ttfts, np.float64)
+        tpot = np.asarray(self._tpots, np.float64)
+        out = {
+            "requests_submitted": s["submitted"],
+            "requests_completed": s["completed"],
+            "tokens_out": s["tokens_out"],
+            "elapsed_s": round(elapsed, 6) if elapsed else None,
+            "tokens_per_s": (
+                round(s["tokens_out"] / elapsed, 3) if elapsed else None
+            ),
+            "ttft_p50_s": float(np.percentile(ttft, 50)) if ttft.size else None,
+            "ttft_p95_s": float(np.percentile(ttft, 95)) if ttft.size else None,
+            "tpot_mean_s": float(tpot.mean()) if tpot.size else None,
+            "ticks": s["ticks"],
+            "decode_steps": s["decode_steps"],
+            "prefill_chunks": s["prefill_chunks"],
+            "prefill_pad_tokens": s["prefill_pad_tokens"],
+            "prefill_ladder": list(self.ladder),
+            "n_slots": self.n_slots,
+            "mean_occupancy": (
+                round(s["occupancy_sum"] / s["decode_steps"], 3)
+                if s["decode_steps"] else None
+            ),
+            "peak_occupancy": s["peak_occupancy"],
+            "mean_queue_depth": (
+                round(s["queue_depth_sum"] / s["queue_samples"], 3)
+                if s["queue_samples"] else None
+            ),
+            "slot_allocs": s["slot_allocs"],
+            "slot_reuses": s["slot_reuses"],
+            "steady_recompiles": s["steady_recompiles"],
+            "decode_executables": execs["decode"],
+            "prefill_executables": execs["prefill"],
+        }
+        return out
+
+    def _push_telemetry_summary(self) -> None:
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_serving(self.stats())
+            except Exception as e:  # observability must never kill serving
+                logger.warning_once(f"serving: telemetry summary failed: {e}")
+
+    def close(self) -> None:
+        """Flush the serving summary into the telemetry stream (no device
+        state to tear down — caches are plain donated arrays)."""
+        self._push_telemetry_summary()

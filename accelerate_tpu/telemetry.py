@@ -159,6 +159,11 @@ class TelemetryRecorder:
             "fallback_saves": 0,
             "async_errors": 0,
         }
+        # Serving block (serving.py): per-request TTFT/TPOT events stream as
+        # they retire; the engine pushes its aggregate summary via
+        # record_serving and it rides the summary as the "serving" block.
+        self._serving_summary: Optional[dict] = None
+        self._serving_requests = 0
         # Counters are process-global (utils/operations.py); a new recorder
         # means a new run's tally.
         collective_counters.reset()
@@ -428,9 +433,22 @@ class TelemetryRecorder:
             ck["fallback_saves"] += 1
         elif event == "checkpoint_async_error":
             ck["async_errors"] += 1
+        elif event == "serving_request_done":
+            self._serving_requests += 1
         record = {"event": event, "step": self.step, "time": time.time()}
         record.update(fields)
         self._write(record)
+
+    def record_serving(self, block: dict) -> None:
+        """Serving-engine aggregate (serving.py ``engine.stats()``): written
+        as a JSONL record and embedded as the summary's ``serving`` block —
+        TTFT/TPOT percentiles, queue depth, slot occupancy, tokens/s,
+        steady-state recompile census. Last push wins."""
+        self._serving_summary = dict(block)
+        self._write({
+            "event": "serving_summary", "step": self.step, "time": time.time(),
+            **self._serving_summary,
+        })
 
     # -- output ------------------------------------------------------------
 
@@ -481,6 +499,10 @@ class TelemetryRecorder:
                 for k, v in self._ckpt.items()
             },
         }
+        if self._serving_summary is not None:
+            # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py):
+            # bench rows embed it like the checkpoint/compile blocks.
+            out["serving"] = dict(self._serving_summary)
         # Executable census: total dispatch-cache size across the watched
         # jitted fns — the number shape bucketing caps at len(buckets).
         sizes = [e["cache_size"] for e in self._watch.values() if e["cache_size"]]

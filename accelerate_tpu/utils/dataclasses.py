@@ -430,6 +430,70 @@ class CompileKwargs(KwargsHandler):
 
 
 @dataclass
+class ServingConfig(KwargsHandler):
+    """Continuous-batching serving engine config (serving.py). OFF by
+    default everywhere: nothing constructs a
+    :class:`~accelerate_tpu.serving.ServingEngine` unless you do — the
+    training path and plain ``generate()`` callers never touch serving
+    code. Passing this handler to ``Accelerator(kwargs_handlers=[...])``
+    only stores it (``accelerator.serving_config``) so
+    ``accelerator.build_serving_engine(model)`` can construct an engine
+    wired to the compile manager and telemetry recorder.
+
+    - ``n_slots``: concurrent sequences — the slot-paged KV cache is
+      ``(L, n_slots, max_len, Hkv, D)``; one decode tick advances every
+      live slot. Size it to the HBM left after params: bigger = higher
+      aggregate tokens/s, until the decode step goes compute-bound.
+    - ``max_len``: per-slot capacity (prompt + continuation); default
+      ``min(max_position_embeddings, 4096)``. ``submit`` rejects requests
+      that cannot fit.
+    - ``prefill_chunks``: explicit chunk-size ladder for chunked prefill;
+      default: the compile manager's seq buckets when one is wired,
+      else pow2 ``min_prefill_chunk..max_prefill_chunk``. Every possible
+      prompt length compiles at most ``len(ladder)`` prefill executables.
+    - ``prefill_chunks_per_tick``: prompt chunks interleaved per decode
+      tick — raise to admit long prompts faster at some decode-latency
+      cost (head-of-line control knob).
+    - ``temperature`` / ``top_k`` / ``top_p`` / ``eos_token_id`` /
+      ``pad_token_id``: sampling settings, engine-wide (the compiled decode
+      step bakes them in). ``max_new_tokens`` is the default per-request
+      budget; ``submit``/``run`` override it per request.
+    - ``cache_dtype``: KV-cache dtype override (default: model dtype).
+    - ``seed``: seeds the idle slots' PRNG pool; each request's stream is
+      the ``rng`` passed at ``submit`` (default ``jax.random.key(0)``).
+    """
+
+    enabled: bool = True
+    n_slots: int = 8
+    max_len: Optional[int] = None
+    max_new_tokens: int = 32
+    prefill_chunks: Optional[list] = None
+    min_prefill_chunk: int = 16
+    max_prefill_chunk: int = 256
+    prefill_chunks_per_tick: int = 1
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: Optional[int] = None
+    cache_dtype: Any = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.prefill_chunks_per_tick < 1:
+            raise ValueError("prefill_chunks_per_tick must be >= 1")
+        if self.min_prefill_chunk < 1 or self.max_prefill_chunk < self.min_prefill_chunk:
+            raise ValueError(
+                "need 1 <= min_prefill_chunk <= max_prefill_chunk, got "
+                f"{self.min_prefill_chunk}..{self.max_prefill_chunk}"
+            )
+
+
+@dataclass
 class JitConfig(KwargsHandler):
     """Compilation policy — the role of the reference's TorchDynamoPlugin
     (reference: utils/dataclasses.py:1031-1118). XLA jit is always on; these

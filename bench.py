@@ -336,6 +336,18 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 for k in ("saves", "save_s", "verify_s", "retries",
                           "torn_skipped", "rollbacks")
             }
+        # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py via
+        # telemetry.record_serving): rows carry it like the checkpoint and
+        # compile blocks so serving-throughput regressions show up in the
+        # perf trajectory.
+        if t.get("serving"):
+            sv = t["serving"]
+            result["telemetry"]["serving"] = {
+                k: sv.get(k)
+                for k in ("requests_completed", "tokens_per_s", "ttft_p50_s",
+                          "ttft_p95_s", "tpot_mean_s", "mean_occupancy",
+                          "steady_recompiles", "decode_executables")
+            }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).
     _emit(round(r2k["tok_s"], 1), unit_2k("; seq-8192 pending"),

@@ -13,8 +13,14 @@ Three rows, one JSON line each:
 - ``streamed``: params held in host RAM, layer-streamed forward
   (dispatch_model with transformer blocks on "cpu") — the reference's
   CPU-offload rows, where per-token cost is dominated by weight streaming.
+- ``--serving`` adds two rows: mixed-length Poisson arrivals through the
+  continuous-batching :class:`ServingEngine` vs the SAME request set through
+  gang-scheduled static-batch ``generate()`` — aggregate tokens/s, p50/p95
+  TTFT (static TTFT = batch completion minus arrival: requests wait for
+  the gang), and recompile/executable counts per phase.
 
     python benchmarks/generate_bench.py [--params-b 1] [--new-tokens 64]
+                                        [--serving] [--qps 8]
 """
 
 import argparse
@@ -41,6 +47,9 @@ def build(params_b: float):
             num_hidden_layers=18, num_attention_heads=16, num_key_value_heads=16,
             max_position_embeddings=2048, dtype=jnp.bfloat16,
         )
+    elif params_b < 0.01:
+        # CPU-verifiable tier (CI smoke of the bench plumbing itself).
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, max_position_embeddings=2048)
     else:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=4096,
@@ -58,6 +67,12 @@ def main():
     ap.add_argument("--streamed-tokens", type=int, default=4)
     ap.add_argument("--int8", action="store_true",
                     help="add a resident_int8 row (DecodeQuant weight-only decode)")
+    ap.add_argument("--serving", action="store_true",
+                    help="add serving rows (continuous batching vs static gang)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--qps", type=float, default=8.0,
+                    help="Poisson arrival rate for the serving rows")
     args = ap.parse_args()
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -151,6 +166,98 @@ def main():
         }), flush=True)
         qm = None  # free the int8 copy + its executables before the
         clear_generation_cache()  # streamed row's per-layer buffers
+
+    # --- Optional rows: continuous batching vs static gang -----------------
+    if args.serving:
+        from accelerate_tpu import ServingConfig, ServingEngine
+        from accelerate_tpu import generation as G
+        from accelerate_tpu.generation import clear_generation_cache
+
+        srng = np.random.default_rng(1)
+        n, slots = args.requests, args.slots
+        lengths = srng.integers(4, max(9, args.prompt_len), n)
+        budgets = np.where(
+            srng.random(n) < 0.5,
+            srng.integers(4, 12, n),
+            srng.integers(max(2, args.new_tokens // 2), args.new_tokens + 1, n),
+        ).astype(int)
+        reqs = [srng.integers(1, cfg.vocab_size, (int(L),), dtype=np.int32)
+                for L in lengths]
+        arrivals = np.cumsum(srng.exponential(1.0 / args.qps, n))
+        useful = int(budgets.sum())
+
+        # Static gang: batches of `slots` in arrival order, left-padded to
+        # the batch max prompt, every row decoding the batch max budget. A
+        # request's TTFT is its batch's completion minus its arrival — the
+        # gang cannot release anything early.
+        clear_generation_cache()
+        t0 = time.perf_counter()
+        batch_done = {}
+        for i0 in range(0, n, slots):
+            batch = list(range(i0, min(i0 + slots, n)))
+            smax = max(len(reqs[i]) for i in batch)
+            bmax = int(max(budgets[i] for i in batch))
+            ids = np.zeros((len(batch), smax), np.int32)
+            mask = np.zeros((len(batch), smax), np.int32)
+            for r, i in enumerate(batch):
+                p = reqs[i]
+                ids[r, smax - len(p):] = p
+                mask[r, smax - len(p):] = 1
+            np.asarray(generate(res_model, ids, max_new_tokens=bmax,
+                                attention_mask=mask))
+            done = time.perf_counter() - t0
+            for i in batch:
+                batch_done[i] = done
+        static_s = time.perf_counter() - t0
+        ttft_static = np.asarray(
+            [max(0.0, batch_done[i] - arrivals[i]) for i in range(n)]
+        )
+        static_execs = sum(
+            int(fn._cache_size()) for fn in G._GEN_LOOP_CACHE.values()
+            if callable(getattr(fn, "_cache_size", None))
+        )
+        print(json.dumps({
+            "row": "serving_static", "seconds": round(static_s, 3),
+            "useful_tokens": useful,
+            "tokens_per_s": round(useful / static_s, 2),
+            "ttft_p50_s": round(float(np.percentile(ttft_static, 50)), 4),
+            "ttft_p95_s": round(float(np.percentile(ttft_static, 95)), 4),
+            "compiled_executables": static_execs,
+        }), flush=True)
+
+        # Continuous batching: Poisson arrivals submitted in real time.
+        t_cap = int(max(lengths[i] + budgets[i] for i in range(n))) + 8
+        engine = ServingEngine(
+            res_model,
+            ServingConfig(n_slots=slots, max_len=t_cap,
+                          max_prefill_chunk=max(16, args.prompt_len)),
+        )
+        t0 = time.perf_counter()
+        nxt = 0
+        while nxt < n or engine.pending:
+            now = time.perf_counter() - t0
+            while nxt < n and arrivals[nxt] <= now:
+                engine.submit(reqs[nxt], max_new_tokens=int(budgets[nxt]))
+                nxt += 1
+            if engine.pending:
+                engine.tick()
+                engine.poll()
+            elif nxt < n:
+                time.sleep(min(0.01, max(0.0, arrivals[nxt] - now)))
+        serve_s = time.perf_counter() - t0
+        st = engine.stats()
+        print(json.dumps({
+            "row": "serving", "seconds": round(serve_s, 3),
+            "useful_tokens": st["tokens_out"],
+            "tokens_per_s": st["tokens_per_s"],
+            "ttft_p50_s": round(st["ttft_p50_s"], 4),
+            "ttft_p95_s": round(st["ttft_p95_s"], 4),
+            "tpot_mean_s": round(st["tpot_mean_s"], 4),
+            "mean_occupancy": st["mean_occupancy"],
+            "decode_executables": st["decode_executables"],
+            "prefill_executables": st["prefill_executables"],
+            "steady_recompiles": st["steady_recompiles"],
+        }), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)
