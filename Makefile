@@ -6,7 +6,7 @@ PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
-        telemetry-smoke warmup-smoke faulttol-smoke serving-smoke
+        telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -89,6 +89,15 @@ warmup-smoke:
 # tokens/s on the same request set. See docs/usage_guides/serving.md.
 serving-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.serving_smoke
+
+# Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
+# search must be deterministic (byte-identical JSON), every candidate must
+# satisfy the divisibility constraints, 10 training steps run under the
+# chosen layout with measured peak HBM within 2x of the prediction, and a
+# second run loads the cached plan (no re-search) and records calibration
+# deltas into it. See docs/usage_guides/auto_parallelism.md.
+plan-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.plan_smoke
 
 # Fault-tolerance gate: SIGTERM a training worker mid-epoch (preemption
 # auto-save + resumable exit code), relaunch with ACCELERATE_RESTART_ATTEMPT=1

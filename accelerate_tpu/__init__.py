@@ -13,7 +13,11 @@ experiment tracking, big-model inference with host offload, and an
 __version__ = "0.1.0"
 
 from .state import AcceleratorState, DistributedType, GradientState, PartialState
-from .parallelism_config import ParallelismConfig, build_mesh_from_env
+from .parallelism_config import (
+    ParallelismConfig,
+    ParallelismOversubscriptionError,
+    build_mesh_from_env,
+)
 from .logging import get_logger
 from .utils import (
     DataLoaderConfiguration,
@@ -79,5 +83,16 @@ from .generation import (  # noqa: E402
     sample_logits,
 )
 from .serving import ServingEngine  # noqa: E402
-from .utils.dataclasses import ServingConfig  # noqa: E402
+from .utils.dataclasses import AutoPlanKwargs, ServingConfig  # noqa: E402
+from .planner import (  # noqa: E402
+    BandwidthTable,
+    ModelProfile,
+    ParallelPlan,
+    Planner,
+    PlannerError,
+    PlanVersionError,
+    enumerate_layouts,
+    predict_step_time,
+    record_calibration,
+)
 from .cp_generation import cp_generate  # noqa: E402

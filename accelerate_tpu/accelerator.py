@@ -75,6 +75,7 @@ from .utils import (
     set_seed,
 )
 from .utils.dataclasses import (
+    AutoPlanKwargs,
     CompileKwargs,
     DistributedDataParallelKwargs,
     FaultToleranceKwargs,
@@ -160,7 +161,7 @@ class Accelerator:
         gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
         step_scheduler_with_optimizer: bool = True,
         kwargs_handlers: Optional[list[KwargsHandler]] = None,
-        parallelism_config: Optional[ParallelismConfig] = None,
+        parallelism_config: "Optional[ParallelismConfig | str]" = None,
         fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
         deepspeed_plugin=None,
         jit_config: Optional[JitConfig] = None,
@@ -196,6 +197,7 @@ class Accelerator:
         self.telemetry_handler = None
         self.compile_handler = None
         self.fault_tolerance_handler = None
+        self.auto_plan_handler = None
         # Serving config (serving.py): stored only — no serving code runs on
         # the training path; build_serving_engine constructs the engine.
         self.serving_config = None
@@ -216,6 +218,8 @@ class Accelerator:
                 self.fault_tolerance_handler = handler
             elif isinstance(handler, ServingConfig):
                 self.serving_config = handler
+            elif isinstance(handler, AutoPlanKwargs):
+                self.auto_plan_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -223,6 +227,25 @@ class Accelerator:
             )
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=ga_steps)
         self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
+
+        # Auto-parallelism (planner.py): parallelism_config="auto" — or an
+        # AutoPlanKwargs handler — defers the layout choice to the planner at
+        # prepare() time (the first call that sees a model). The mesh stays
+        # unbuilt until then; an explicit ParallelismConfig is unchanged.
+        if isinstance(parallelism_config, str):
+            if parallelism_config != "auto":
+                raise ValueError(
+                    f"parallelism_config accepts a ParallelismConfig or the "
+                    f"string 'auto', got {parallelism_config!r}"
+                )
+            parallelism_config = None
+            if self.auto_plan_handler is None:
+                self.auto_plan_handler = AutoPlanKwargs()
+        self._auto_plan_pending = (
+            self.auto_plan_handler is not None and self.auto_plan_handler.enabled
+        )
+        self.active_plan = None       # resolved ParallelPlan (auto mode only)
+        self.active_plan_meta = None  # {"path": ..., "from_cache": ...}
 
         self.state = AcceleratorState(
             mixed_precision=mixed_precision,
@@ -603,6 +626,10 @@ class Accelerator:
                     )
                 pairings[cur] = obj
                 tx_models.append(models[cur])
+        if models and self._auto_plan_pending:
+            # Resolve the auto-parallelism plan from the FIRST prepared model
+            # before any mesh-dependent planning happens (planner.py).
+            self._resolve_auto_plan(models[0])
         for i, model in enumerate(models):
             self._prepare_state(model, pairings[i])
         tx_seen = 0
@@ -712,6 +739,121 @@ class Accelerator:
             len(self._schedulers),
             len(self._custom_objects),
         )
+
+    def _resolve_auto_plan(self, model: Model) -> None:
+        """Auto-parallelism (planner.py): search — or load the cached —
+        :class:`~accelerate_tpu.planner.ParallelPlan` for ``model`` on this
+        process's devices, install its layout as the ParallelismConfig, and
+        apply its remat/microbatch decisions. Runs at most once, from the
+        first prepare() that sees a model."""
+        self._auto_plan_pending = False
+        handler = self.auto_plan_handler
+        if self.state.parallelism_config is not None:
+            logger.warning(
+                "auto-plan: an explicit ParallelismConfig is already set — "
+                "the planner defers to it (drop parallelism_config= to let "
+                "the search choose)."
+            )
+            return
+        if self.state._mesh is not None:
+            raise RuntimeError(
+                "auto-plan: the device mesh was already built (something "
+                "touched accelerator.mesh before prepare()). Construct the "
+                "Accelerator with parallelism_config='auto' and prepare the "
+                "model before any mesh access."
+            )
+        module = getattr(model, "module", None)
+        cfg = getattr(module, "config", None)
+        if module is None or cfg is None:
+            raise ValueError(
+                "auto-plan needs an in-framework module carrying a config "
+                "(divisibility constraints + activation model); wrap your "
+                "model with Model.from_flax(module, ...) where module.config "
+                "exists, or pass an explicit ParallelismConfig."
+            )
+        from .planner import BandwidthTable, Planner, default_tp_rules, layout_str
+
+        label = f"{type(cfg).__name__}:{getattr(cfg, 'num_hidden_layers', '?')}L"
+        planner = Planner(
+            module,
+            cfg,
+            n_devices=len(self.state.devices),
+            hbm_gib=handler.hbm_gib,
+            seq=handler.seq,
+            per_chip_batch=handler.per_chip_batch,
+            optimizer=handler.optimizer,
+            tp_rules=model.tp_rules or default_tp_rules(module, cfg),
+            axes=tuple(handler.axes),
+            pinned=handler.pinned,
+            bandwidths=BandwidthTable.from_dict(handler.bandwidths),
+            label=label,
+        )
+        plans_dir = handler.plans_dir or os.path.join(
+            self.project_dir or ".", "plans"
+        )
+        plan, path, from_cache = planner.resolve(
+            plans_dir, use_cache=handler.use_cache
+        )
+        self.active_plan = plan
+        self.active_plan_meta = {"path": path, "from_cache": from_cache}
+        pc = plan.to_parallelism_config()
+        self.state.parallelism_config = pc
+        if pc.tp_size > 1 and not model.tp_rules and planner.tp_rules:
+            # Train with the SAME rule table the plan was priced with —
+            # otherwise a tp>1 layout would silently replicate every leaf.
+            model.tp_rules = list(planner.tp_rules)
+        logger.info(
+            "auto-plan: %s layout %s (predicted %.4gs/step, %.3g GiB/chip%s)"
+            " — artifact %s",
+            "loaded cached" if from_cache else "searched",
+            layout_str(plan.layout), plan.predicted_step_s,
+            plan.predicted_hbm_gib,
+            ", OVER BUDGET" if plan.over_budget else "",
+            path,
+            main_process_only=True,
+        )
+        if plan.over_budget:
+            logger.warning(
+                "auto-plan: no layout fit %.1f GiB/chip — training with the "
+                "best-effort plan %s (predicted %.3g GiB). Expect OOM; see "
+                "the plan's rejection log (%s) and docs/usage_guides/"
+                "auto_parallelism.md.",
+                plan.hbm_gib_budget, layout_str(plan.layout),
+                plan.predicted_hbm_gib, path,
+            )
+        # Apply the remat decision the plan priced (same rebuild contract as
+        # fsdp_plugin.activation_checkpointing).
+        if handler.apply_remat and plan.remat and getattr(cfg, "remat", None) is False:
+            import dataclasses as _dc
+
+            new_module = type(module)(
+                _dc.replace(cfg, remat=True, remat_policy=plan.remat_policy)
+            )
+            model.module = new_module
+            model.apply_fn = new_module.apply
+            logger.info(
+                "auto-plan: enabled remat (policy=%s) on %s per the plan.",
+                plan.remat_policy, type(module).__name__,
+                main_process_only=True,
+            )
+        if (
+            handler.apply_microbatches
+            and plan.microbatches > 1
+            and self.gradient_state.num_steps == 1
+        ):
+            self.gradient_accumulation_steps = plan.microbatches
+            logger.info(
+                "auto-plan: gradient_accumulation_steps=%d per the plan's "
+                "microbatch ladder.", plan.microbatches,
+                main_process_only=True,
+            )
+        if self.telemetry is not None:
+            self.telemetry.note_plan(
+                plan.to_json_dict(), path,
+                calibrate_after=handler.calibrate_after,
+            )
+        if self.compile_manager is not None:
+            self.compile_manager.note_plan(plan)
 
     def _apply_activation_checkpointing(self, model: Model):
         """Honor ``fsdp_plugin.activation_checkpointing`` (reference FSDP

@@ -5,7 +5,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from . import config, convert, env, estimate, launch, merge, test
+from . import config, convert, env, estimate, launch, merge, plan, test
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -14,7 +14,7 @@ def build_parser() -> argparse.ArgumentParser:
         description="accelerate-tpu command line interface",
     )
     subparsers = parser.add_subparsers(dest="command", required=True)
-    for mod in (config, env, launch, test, estimate, merge, convert):
+    for mod in (config, env, launch, test, estimate, plan, merge, convert):
         mod.add_parser(subparsers)
     return parser
 

@@ -162,15 +162,19 @@ def estimate_memory(model: str, dtypes: list[str]) -> list[dict]:
 
 
 def _parse_parallelism(spec: str):
-    """'dp_shard=64,tp=2' → ParallelismConfig. Raises ValueError with the
-    offending token and the valid axes on any malformed part."""
+    """'dp_shard=64,tp=2' (or 'dp:2,tp:4'; 'dp' aliases dp_shard) →
+    ParallelismConfig. Raises ValueError with the offending token and the
+    valid axes on any malformed part."""
     from ..parallelism_config import ParallelismConfig
 
     valid = ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep", "pp")
     kwargs = {}
     for part in spec.split(","):
-        axis, _, deg = part.partition("=")
+        sep = "=" if "=" in part else ":"
+        axis, _, deg = part.partition(sep)
         axis = axis.strip().removesuffix("_size")
+        if axis == "dp":
+            axis = "dp_shard"
         if not axis and not deg:
             continue
         if axis not in valid:
@@ -192,7 +196,9 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
     """Per-chip HBM under a ParallelismConfig — the number a TPU user
     actually needs, computed with the trainer's own sharding planner
     (utils/estimate_memory.py; beats the reference's whole-model table,
-    commands/estimate.py:66-318)."""
+    commands/estimate.py:66-318). ``--plan <file>`` takes the layout, remat
+    policy and training shape from a planner artifact instead of flags —
+    the same estimate_per_chip path the planner itself scored with."""
     import numpy as np
 
     from ..utils.estimate_memory import (
@@ -201,6 +207,24 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
         replicated_large_leaves,
     )
 
+    plan = None
+    if getattr(args, "plan", None):
+        from ..planner import ParallelPlan, PlanVersionError
+
+        try:
+            plan = ParallelPlan.load(args.plan)
+        except (OSError, PlanVersionError, ValueError, KeyError) as e:
+            print(f"--plan: cannot load {args.plan!r}: {e}", file=sys.stderr)
+            return 2
+        # The plan records the shape it was priced for; flags still win
+        # when the user passed them explicitly.
+        if args.seq == 2048:
+            args.seq = plan.seq
+        if args.per_chip_batch == 1:
+            args.per_chip_batch = plan.per_chip_batch
+        args.parallelism = ",".join(
+            f"{k}={v}" for k, v in plan.layout.items() if v > 1
+        ) or "dp_shard=1"
     if args.dtypes[0] not in ("fp32", "bf16", "fp16"):
         print(
             f"--parallelism estimates the TRAINING working set; master "
@@ -213,10 +237,12 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
         return 2
     try:
         cfg, module = _builtin_module(args.model_name)
-        if getattr(args, "remat", False) and hasattr(cfg, "remat"):
+        want_remat = getattr(args, "remat", False) or (plan is not None and plan.remat)
+        if want_remat and hasattr(cfg, "remat"):
             import dataclasses as _dc
 
-            cfg = _dc.replace(cfg, remat=True)
+            policy = plan.remat_policy if plan is not None and plan.remat else cfg.remat_policy
+            cfg = _dc.replace(cfg, remat=True, remat_policy=policy)
             module = type(module)(cfg)
     except KeyError:
         print(
@@ -272,7 +298,7 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
 
 
 def estimate_command(args: argparse.Namespace) -> int:
-    if getattr(args, "parallelism", None):
+    if getattr(args, "parallelism", None) or getattr(args, "plan", None):
         return estimate_topology_command(args)
     rows = estimate_memory(args.model_name, args.dtypes)
     if args.json:
@@ -303,8 +329,15 @@ def add_parser(subparsers) -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true", help="Machine-readable output")
     p.add_argument(
         "--parallelism", default=None,
-        help="Topology mode: per-chip HBM under e.g. 'dp_shard=64,tp=2' "
-             "(builtin model specs only; uses the trainer's sharding planner)",
+        help="Topology mode: per-chip HBM under e.g. 'dp_shard=64,tp=2' or "
+             "'dp:64,tp:2' (builtin model specs only; uses the trainer's "
+             "sharding planner)",
+    )
+    p.add_argument(
+        "--plan", default=None,
+        help="Topology mode from a ParallelPlan artifact (accelerate-tpu "
+             "plan --out): layout, remat policy and training shape come "
+             "from the plan file",
     )
     p.add_argument("--seq", type=int, default=2048, help="Sequence length (topology mode)")
     p.add_argument("--per-chip-batch", dest="per_chip_batch", type=int, default=1)

@@ -1,0 +1,176 @@
+"""`accelerate-tpu plan` — the auto-parallelism planner as a CLI.
+
+Sibling of ``estimate-memory``: where estimate prices ONE layout, ``plan``
+searches them all (planner.py) and prints the ranked table — chosen layout
+first, runner-ups with why they lost (slower / over budget) — and optionally
+writes the versioned :class:`~accelerate_tpu.planner.ParallelPlan` JSON
+artifact that ``Accelerator(parallelism_config="auto")`` and
+``estimate-memory --plan`` consume.
+
+Examples::
+
+    accelerate-tpu plan llama:7b --devices 64 --hbm-gib 16 --seq 2048
+    accelerate-tpu plan llama:7b --devices 64 --pin tp=8 --out plan.json
+    accelerate-tpu plan llama:tiny --devices 8 --axes dp_shard,tp,pp --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_pins(spec: str) -> dict:
+    """'tp=2,pp=2' (or 'tp:2') → {'tp': 2, 'pp': 2}."""
+    pins = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        sep = "=" if "=" in part else ":"
+        axis, _, deg = part.partition(sep)
+        axis = axis.strip().removesuffix("_size")
+        if axis == "dp":
+            axis = "dp_shard"
+        try:
+            pins[axis] = int(deg)
+        except ValueError:
+            raise ValueError(
+                f"--pin: {part!r} needs the form <axis>=<int>, e.g. tp=2"
+            ) from None
+    return pins
+
+
+def plan_command(args: argparse.Namespace) -> int:
+    from ..planner import (
+        ALL_SEARCH_AXES,
+        BandwidthTable,
+        Planner,
+        PlannerError,
+        default_tp_rules,
+        layout_str,
+    )
+    from .estimate import _builtin_module
+
+    try:
+        cfg, module = _builtin_module(args.model_name)
+    except KeyError:
+        print(
+            f"plan needs a builtin model spec (e.g. 'llama:7b', 'llama:tiny', "
+            f"'mixtral:tiny') to build the sharding planner; got "
+            f"{args.model_name!r}.",
+            file=sys.stderr,
+        )
+        return 2
+    n_devices = args.devices
+    if n_devices is None:
+        import jax
+
+        n_devices = len(jax.devices())
+    try:
+        pinned = _parse_pins(args.pin) if args.pin else None
+        bandwidths = BandwidthTable.from_dict(
+            json.loads(args.bandwidths) if args.bandwidths else None
+        )
+        axes = tuple(a.strip() for a in args.axes.split(",") if a.strip())
+        planner = Planner(
+            module,
+            cfg,
+            n_devices=n_devices,
+            hbm_gib=args.hbm_gib,
+            seq=args.seq,
+            per_chip_batch=args.per_chip_batch,
+            optimizer=args.optimizer,
+            tp_rules=default_tp_rules(module, cfg),
+            axes=axes,
+            pinned=pinned,
+            bandwidths=bandwidths,
+            label=args.model_name,
+            max_rejections=max(args.top - 1, 1),
+        )
+        plan = planner.search()
+    except (PlannerError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.out:
+        plan.save(args.out)
+    if args.json:
+        print(plan.to_json(), end="")
+        return 1 if plan.over_budget else 0
+
+    print(
+        f"Parallelism plan for `{args.model_name}` on {n_devices} devices "
+        f"(seq {args.seq}, batch/chip {args.per_chip_batch}, "
+        f"{args.optimizer}, budget {args.hbm_gib:g} GiB/chip):"
+    )
+    header = (
+        f"  {'rank':>4} | {'layout':28s} | {'remat':8s} | {'mb':>3} | "
+        f"{'step (ms)':>10} | {'HBM (GiB)':>9} | verdict"
+    )
+    print(header)
+    print("  " + "-" * (len(header) - 2))
+    rows = [{
+        "layout": plan.layout, "remat": plan.remat,
+        "remat_policy": plan.remat_policy, "microbatches": plan.microbatches,
+        "predicted_step_s": plan.predicted_step_s,
+        "predicted_hbm_gib": plan.predicted_hbm_gib,
+        "reason": "OVER BUDGET (best effort)" if plan.over_budget else "chosen",
+    }]
+    rows += [r for r in plan.rejections if r.get("layout") is not None]
+    for rank, r in enumerate(rows[: args.top], 1):
+        remat = r.get("remat_policy") if r.get("remat") else "none"
+        step_ms = (r.get("predicted_step_s") or 0) * 1e3
+        print(
+            f"  {rank:>4} | {layout_str(r['layout']):28s} | {remat:8s} | "
+            f"{r.get('microbatches', 1):>3} | {step_ms:>10.3f} | "
+            f"{r.get('predicted_hbm_gib', 0):>9.3f} | {r['reason']}"
+        )
+    dropped = [r for r in plan.rejections if r.get("layout") is None]
+    for r in dropped:
+        print(f"  {r['reason']}")
+    if plan.over_budget:
+        print(
+            f"  WARNING: no layout fits {args.hbm_gib:g} GiB/chip — the top "
+            f"row is the lowest-HBM best effort. Expect OOM."
+        )
+    if args.out:
+        print(f"  plan artifact written to {args.out}")
+    return 1 if plan.over_budget else 0
+
+
+def add_parser(subparsers) -> argparse.ArgumentParser:
+    p = subparsers.add_parser(
+        "plan",
+        help="Search device-layout candidates for a model and emit a "
+             "ParallelPlan artifact",
+    )
+    p.add_argument(
+        "model_name",
+        help="Builtin model spec: 'llama:7b', 'llama:1b', 'llama:tiny', "
+             "'mixtral:tiny', 'opt:6b7', ...",
+    )
+    p.add_argument("--devices", type=int, default=None,
+                   help="Device count to plan for (default: visible devices)")
+    p.add_argument("--hbm-gib", dest="hbm_gib", type=float, default=16.0,
+                   help="Per-chip HBM budget (v5e: 16)")
+    p.add_argument("--seq", type=int, default=2048, help="Sequence length")
+    p.add_argument("--per-chip-batch", dest="per_chip_batch", type=int, default=1,
+                   help="Samples per chip at pure data parallelism (the global "
+                        "batch is per_chip_batch x devices for every layout)")
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "adam", "sgd", "momentum", "lion", "adafactor"])
+    p.add_argument("--axes", default="dp_replicate,dp_shard,tp,cp,pp,ep",
+                   help="Comma-separated axes the search may raise above 1")
+    p.add_argument("--pin", default=None,
+                   help="Force axis degrees, e.g. 'tp=2,pp=2' — the rest is "
+                        "still searched")
+    p.add_argument("--bandwidths", default=None,
+                   help='JSON BandwidthTable overrides, e.g. '
+                        '\'{"ici_gbps": 45, "mfu": 0.35}\'')
+    p.add_argument("--top", type=int, default=8,
+                   help="Ranked rows to print / rejections to log")
+    p.add_argument("--out", default=None, help="Write the plan artifact here")
+    p.add_argument("--json", action="store_true",
+                   help="Print the full plan artifact JSON instead of the table")
+    p.set_defaults(func=plan_command)
+    return p

@@ -387,6 +387,7 @@ class CompileManager:
         self._seen: set = set(self.manifest._digests) if self.manifest else set()
         self._steps: list[dict] = []
         self._auto_ladders: Optional[dict] = None
+        self._plan = None  # resolved ParallelPlan, via note_plan
         self.pad_events = 0
         self.oversize_events = 0
         self.warmup_stats = {"signatures_compiled": 0, "seconds": 0.0, "skipped": 0}
@@ -395,6 +396,31 @@ class CompileManager:
             budget = accelerator.jit_config.persistent_cache_budget_bytes
         cache_dir = accelerator.jit_config.persistent_cache_dir
         self.cache = ManagedPersistentCache(cache_dir, budget) if cache_dir else None
+
+    # -- auto-parallelism plan hook ---------------------------------------
+
+    def note_plan(self, plan) -> None:
+        """Warm toward the chosen plan's step shape (planner.py): the plan's
+        sequence length and per-rank batch are grafted onto the fixed/auto
+        bucket ladders so the very first real batch pads to the planned
+        shape — the step the warmup compiles is the step training runs."""
+        self._plan = plan
+        seq = int(getattr(plan, "seq", 0) or 0)
+        layout = getattr(plan, "layout", None) or {}
+        dp = max(1, int(layout.get("dp_replicate", 1)) * int(layout.get("dp_shard", 1)))
+        batch = int(getattr(plan, "per_chip_batch", 0) or 0) * int(
+            getattr(plan, "n_devices", 0) or 0
+        ) // dp
+        h = self.handler
+        for kind, dim in (("seq", seq), ("batch", batch)):
+            if dim <= 0:
+                continue
+            ladder = h.seq_buckets if kind == "seq" else h.batch_buckets
+            if ladder is not None and dim not in ladder:
+                ladder.append(dim)
+                ladder.sort()
+            if self._auto_ladders and dim not in self._auto_ladders.get(kind, []):
+                self._auto_ladders[kind] = sorted(self._auto_ladders[kind] + [dim])
 
     # -- bucketing ---------------------------------------------------------
 

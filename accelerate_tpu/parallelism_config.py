@@ -26,6 +26,13 @@ from .utils.constants import MESH_AXIS_ORDER, PARALLELISM_CONFIG_PREFIX
 from .utils.environment import get_int_from_env, parse_choice_from_env
 
 
+class ParallelismOversubscriptionError(ValueError):
+    """The configured axis degrees multiply to MORE than the device count —
+    a different (and more common) failure than a non-dividing product, so it
+    gets its own message naming each offending axis and the env var that
+    sets it."""
+
+
 @dataclasses.dataclass
 class ParallelismConfig:
     """Degrees for every first-class parallelism axis.
@@ -232,6 +239,21 @@ class ParallelismConfig:
         fixed = self.total_size
         if fixed == n_devices:
             return self
+        if fixed > n_devices:
+            # "Product does not divide device count" is actively misleading
+            # here — nothing can be filled in; an axis must SHRINK. Name the
+            # offending axes and their env vars.
+            p = PARALLELISM_CONFIG_PREFIX
+            axes = [
+                f"{ax}={self.axis_size(ax)} ({p}{ax.upper()}_SIZE)"
+                for ax in MESH_AXIS_ORDER + ("pp",)
+                if self.axis_size(ax) > 1
+            ]
+            raise ParallelismOversubscriptionError(
+                f"parallelism axes multiply to {fixed} but only {n_devices} "
+                f"device(s) are visible: {', '.join(axes) or 'none >1'}. "
+                f"Reduce one of these axes (or launch with more devices)."
+            )
         if n_devices % fixed != 0:
             raise ValueError(
                 f"parallelism product {fixed} does not divide device count {n_devices}"

@@ -44,7 +44,7 @@ import jax
 import numpy as np
 
 from .logging import get_logger
-from .utils.memory import get_device_memory_stats
+from .utils.memory import get_device_memory_stats, live_bytes_on_device
 from .utils.operations import collective_counters, gather
 
 logger = get_logger(__name__)
@@ -164,6 +164,14 @@ class TelemetryRecorder:
         # record_serving and it rides the summary as the "serving" block.
         self._serving_summary: Optional[dict] = None
         self._serving_requests = 0
+        # Auto-parallelism plan (planner.py): note_plan installs the active
+        # plan; after _plan_calibrate_after steps the measured step time +
+        # peak HBM are written back into the plan artifact (the calibration
+        # loop) and the summary carries a "plan" block.
+        self._plan: Optional[dict] = None
+        self._plan_path: Optional[str] = None
+        self._plan_calibrate_after = 0
+        self._plan_calibration: Optional[dict] = None
         # Counters are process-global (utils/operations.py); a new recorder
         # means a new run's tally.
         collective_counters.reset()
@@ -223,6 +231,7 @@ class TelemetryRecorder:
         every = self.handler.straggler_probe_every
         if every and self.step % every == 0:
             self._straggler_probe(wall_s)
+        self._maybe_calibrate_plan()
         self._forward_to_trackers(record)
 
     def on_backward(self, grad_fn, batch, wall_s: float):
@@ -364,6 +373,11 @@ class TelemetryRecorder:
             return {"hbm_bytes_in_use": None, "hbm_peak_bytes": self._peak_hbm}
         stats = get_device_memory_stats()
         in_use = stats.get("bytes_in_use")
+        if in_use is None:
+            # Backends without memory_stats (the virtual CPU mesh): gauge the
+            # live-array census instead so peak-HBM tracking — and the
+            # planner's predicted-vs-measured calibration — still works.
+            in_use = live_bytes_on_device()
         peak = stats.get("peak_bytes_in_use", in_use)
         if peak is not None:
             peak = int(peak)
@@ -439,6 +453,95 @@ class TelemetryRecorder:
         record.update(fields)
         self._write(record)
 
+    def note_plan(self, plan: dict, path: Optional[str],
+                  calibrate_after: int = 10) -> None:
+        """Install the resolved auto-parallelism plan (planner.py). The
+        summary gains a ``plan`` block (predicted vs measured step time /
+        peak HBM) and, when ``path`` is set, measurements are written back
+        into the artifact after ``calibrate_after`` steps."""
+        self._plan = dict(plan)
+        self._plan_path = path
+        self._plan_calibrate_after = int(calibrate_after)
+        self._write({
+            "event": "plan",
+            "step": self.step,
+            "time": time.time(),
+            "layout": self._plan.get("layout"),
+            "predicted_step_s": self._plan.get("predicted_step_s"),
+            "predicted_hbm_gib": self._plan.get("predicted_hbm_gib"),
+            "path": path,
+        })
+
+    def _plan_measurements(self) -> tuple[Optional[float], Optional[float]]:
+        """(measured p50 step seconds, measured peak HBM GiB) so far."""
+        step_s = None
+        if self._step_times:
+            step_s = float(np.percentile(np.asarray(self._step_times), 50))
+        peak_gib = self._peak_hbm / (1024 ** 3) if self._peak_hbm else None
+        return step_s, peak_gib
+
+    def _maybe_calibrate_plan(self, final: bool = False) -> None:
+        if (
+            self._plan is None
+            or self._plan_path is None
+            or self._plan_calibration is not None
+            or not self._plan_calibrate_after
+        ):
+            return
+        if not final and self.step < self._plan_calibrate_after:
+            return
+        if not self._step_times:
+            return
+        step_s, peak_gib = self._plan_measurements()
+        try:
+            from .planner import record_calibration
+
+            cal = record_calibration(
+                self._plan_path,
+                measured_step_s=step_s,
+                measured_peak_hbm_gib=peak_gib,
+                steps=len(self._step_times),
+            )
+        except Exception as e:  # calibration must never kill training
+            logger.warning_once(f"telemetry: plan calibration failed: {e}")
+            return
+        if cal is not None:
+            self._plan_calibration = cal
+            self._write({
+                "event": "plan_calibration",
+                "step": self.step,
+                "time": time.time(),
+                **{k: cal.get(k) for k in (
+                    "runs", "measured_step_s", "measured_peak_hbm_gib",
+                    "step_time_ratio", "hbm_ratio", "mfu_effective",
+                )},
+            })
+
+    def plan_block(self) -> Optional[dict]:
+        """The summary's ``plan`` block: predicted vs measured, calibration
+        deltas — the evidence row bench.py embeds."""
+        if self._plan is None:
+            return None
+        step_s, peak_gib = self._plan_measurements()
+        predicted_s = self._plan.get("predicted_step_s")
+        predicted_gib = self._plan.get("predicted_hbm_gib")
+        block = {
+            "layout": self._plan.get("layout"),
+            "predicted_step_s": predicted_s,
+            "predicted_hbm_gib": predicted_gib,
+            "measured_step_p50_s": step_s,
+            "measured_peak_hbm_gib": peak_gib,
+            "calibrated": self._plan_calibration is not None,
+        }
+        if step_s and predicted_s:
+            block["step_time_ratio"] = step_s / predicted_s
+        if peak_gib and predicted_gib:
+            block["hbm_ratio"] = peak_gib / predicted_gib
+        if self._plan_calibration:
+            block["calibration_runs"] = self._plan_calibration.get("runs")
+            block["mfu_effective"] = self._plan_calibration.get("mfu_effective")
+        return block
+
     def record_serving(self, block: dict) -> None:
         """Serving-engine aggregate (serving.py ``engine.stats()``): written
         as a JSONL record and embedded as the summary's ``serving`` block —
@@ -503,6 +606,11 @@ class TelemetryRecorder:
             # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py):
             # bench rows embed it like the checkpoint/compile blocks.
             out["serving"] = dict(self._serving_summary)
+        plan_block = self.plan_block()
+        if plan_block is not None:
+            # Auto-parallelism plan block (planner.py): predicted vs
+            # measured step time / peak HBM + calibration state.
+            out["plan"] = plan_block
         # Executable census: total dispatch-cache size across the watched
         # jitted fns — the number shape bucketing caps at len(buckets).
         sizes = [e["cache_size"] for e in self._watch.values() if e["cache_size"]]
@@ -525,6 +633,9 @@ class TelemetryRecorder:
         return out
 
     def close(self):
+        # A short run that never reached calibrate_after still calibrates on
+        # the way out — partial measurements beat none for the next launch.
+        self._maybe_calibrate_plan(final=True)
         if self._fh is not None:
             self._write({"event": "summary", "time": time.time(), **self.summary()})
             self._fh.close()

@@ -1,0 +1,165 @@
+"""`make plan-smoke`: the auto-parallelism planner acceptance loop on the
+virtual 8-device CPU mesh.
+
+1. **Determinism**: two independent searches over identical inputs produce
+   byte-identical plan JSON (no timestamps, sorted keys, rounded floats).
+2. **Validity**: every enumerated candidate (chosen + rejection log)
+   satisfies the divisibility constraints (device-count factorization,
+   heads/kv % tp, layers % pp, seq % cp).
+3. **Training**: ``Accelerator(parallelism_config="auto")`` resolves the
+   plan at prepare(), trains 10 steps of a tiny Llama under the chosen
+   layout without error, and telemetry's measured peak HBM lands within 2x
+   of the plan's per-chip prediction.
+4. **Cache + calibration**: a second run over the same project dir loads
+   the cached artifact (no re-search) and the calibration loop has written
+   measured-vs-predicted deltas (runs, step_time_ratio, mfu_effective)
+   back into the plan file.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+SEQ, BATCH, STEPS = 64, 8, 10
+HBM_GIB = 16.0
+
+
+def _search_plan(label="llama:tiny"):
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.planner import Planner
+
+    cfg = LlamaConfig.tiny(dtype=jax.numpy.float32)
+    module = LlamaForCausalLM(cfg)
+    planner = Planner(
+        module, cfg, n_devices=8, hbm_gib=HBM_GIB, seq=SEQ,
+        per_chip_batch=BATCH // 8, label=label,
+        axes=("dp_replicate", "dp_shard", "tp"),
+    )
+    return planner.search()
+
+
+def _assert_candidate_valid(layout: dict, heads=4, kv_heads=2, layers=2):
+    sizes = {k: int(v) for k, v in layout.items()}
+    product = 1
+    for ax in ("dp_replicate", "dp_shard", "cp", "sp", "tp"):
+        product *= sizes.get(ax, 1)
+    product *= sizes.get("pp", 1)
+    assert product == 8, f"layout {layout} does not cover 8 devices"
+    tp = sizes.get("tp", 1)
+    assert heads % tp == 0 and kv_heads % tp == 0, f"tp={tp} violates heads"
+    assert layers % sizes.get("pp", 1) == 0, f"pp violates layers"
+    assert SEQ % sizes.get("cp", 1) == 0, f"cp violates seq"
+
+
+def _train_run(project_dir):
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, cross_entropy_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import AutoPlanKwargs, TelemetryKwargs, set_seed
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    set_seed(0)
+
+    acc = Accelerator(
+        parallelism_config="auto",
+        project_dir=project_dir,
+        kwargs_handlers=[
+            AutoPlanKwargs(
+                hbm_gib=HBM_GIB, seq=SEQ, per_chip_batch=BATCH // 8,
+                calibrate_after=STEPS // 2,
+            ),
+            TelemetryKwargs(log_every=0, straggler_probe_every=0),
+        ],
+    )
+    cfg = LlamaConfig.tiny(dtype=jax.numpy.float32)
+    module = LlamaForCausalLM(cfg)
+    ids = np.zeros((BATCH, SEQ), np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    model, _ = acc.prepare(model, optax.adamw(1e-3))
+
+    def loss_fn(params, batch):
+        logits = model.module.apply({"params": params}, batch["input_ids"])
+        return cross_entropy_loss(logits, batch["labels"])
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    rng = np.random.default_rng(0)
+    metrics = None
+    for _ in range(STEPS):
+        batch = {
+            "input_ids": rng.integers(0, 255, (BATCH, SEQ)).astype(np.int32),
+            "labels": rng.integers(0, 255, (BATCH, SEQ)).astype(np.int32),
+        }
+        state, metrics = step(state, batch)
+    loss = float(np.asarray(metrics["loss"]))
+    assert np.isfinite(loss), f"training diverged under the planned layout: {loss}"
+    summary = acc.telemetry.summary()
+    acc.telemetry.close()
+    return acc.active_plan, dict(acc.active_plan_meta), summary
+
+
+def main() -> int:
+    # 1. Determinism: independent searches emit identical bytes.
+    j1, j2 = _search_plan().to_json(), _search_plan().to_json()
+    assert j1 == j2, "same inputs produced different plan JSON"
+    plan = json.loads(j1)
+    print(f"plan-smoke: search deterministic "
+          f"({len(plan['rejections'])} rejections logged)")
+
+    # 2. Every enumerated candidate satisfies the constraints.
+    _assert_candidate_valid(plan["layout"])
+    for rej in plan["rejections"]:
+        if rej.get("layout") is not None:
+            _assert_candidate_valid(rej["layout"])
+    print("plan-smoke: all candidates satisfy divisibility constraints")
+
+    project_dir = tempfile.mkdtemp(prefix="plan_smoke_")
+
+    # 3. Cold run: search, train 10 steps, HBM within 2x of prediction.
+    active, meta, summary = _train_run(project_dir)
+    assert meta["from_cache"] is False, "first run must search, not hit cache"
+    assert os.path.exists(meta["path"]), "plan artifact missing"
+    block = summary.get("plan") or {}
+    measured_gib = block.get("measured_peak_hbm_gib")
+    predicted_gib = active.predicted_hbm_gib
+    assert measured_gib, f"telemetry recorded no peak HBM: {block}"
+    ratio = measured_gib / predicted_gib
+    assert ratio <= 2.0, (
+        f"measured peak {measured_gib:.4f} GiB is >2x predicted "
+        f"{predicted_gib:.4f} GiB (ratio {ratio:.2f})"
+    )
+    print(f"plan-smoke: trained {STEPS} steps under "
+          f"{ {k: v for k, v in active.layout.items() if v > 1} or 'dp=1' }; "
+          f"measured/predicted HBM ratio {ratio:.2f} (<= 2.0)")
+
+    # 4. Warm run: cached plan, no re-search, calibration written back.
+    active2, meta2, _ = _train_run(project_dir)
+    assert meta2["from_cache"] is True, "second run must load the cached plan"
+    assert meta2["path"] == meta["path"]
+    assert active2.layout == active.layout, "cached plan changed the layout"
+    with open(meta["path"]) as f:
+        artifact = json.load(f)
+    cal = artifact.get("calibration") or {}
+    assert cal.get("runs", 0) >= 2, f"calibration not recorded: {cal}"
+    for key in ("measured_step_s", "step_time_ratio", "mfu_effective",
+                "measured_peak_hbm_gib"):
+        assert cal.get(key) is not None, f"calibration missing {key}: {cal}"
+    print(f"plan-smoke: cached plan reused; calibration after {cal['runs']} runs "
+          f"(step_time_ratio {cal['step_time_ratio']:.1f}, "
+          f"mfu_effective {cal['mfu_effective']:.2g})")
+    print("plan-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -430,6 +430,71 @@ class CompileKwargs(KwargsHandler):
 
 
 @dataclass
+class AutoPlanKwargs(KwargsHandler):
+    """Auto-parallelism planner config (planner.py). Passing this handler to
+    ``Accelerator(kwargs_handlers=[...])`` — or passing
+    ``Accelerator(parallelism_config="auto")`` — turns the subsystem on: the
+    first ``prepare()`` call resolves a :class:`~accelerate_tpu.planner.ParallelPlan`
+    for the prepared model (cached under ``<project_dir>/plans/``), installs
+    its layout as the ``ParallelismConfig``, applies its remat policy, and —
+    when a :class:`TelemetryKwargs` handler is also present — writes measured
+    step time / peak HBM back into the plan artifact after
+    ``calibrate_after`` steps so repeated runs tighten the cost model.
+    Without the handler (and without ``"auto"``) nothing changes: no planner
+    code runs and ``Accelerator`` behavior is byte-identical.
+
+    - ``hbm_gib``: per-chip HBM budget the plan must fit (v5e: 16).
+    - ``seq`` / ``per_chip_batch``: the training shape the plan is priced
+      for. ``per_chip_batch`` is samples per chip at pure data parallelism —
+      the global batch is ``per_chip_batch × device count`` for every
+      candidate layout, so predicted step times compare.
+    - ``axes``: mesh axes the search may raise above 1. Defaults to
+      ``(dp_replicate, dp_shard, tp)`` — cp/pp/ep layouts need model/loss
+      support the auto path cannot verify; enable them explicitly (the
+      ``accelerate-tpu plan`` CLI searches all axes by default).
+    - ``pinned``: axis → degree overrides the search must honor
+      (``{"tp": 2}``); the rejection log shows what pinning cost.
+    - ``bandwidths``: dict overriding :class:`~accelerate_tpu.planner.BandwidthTable`
+      fields (ici_gbps, dcn_gbps, flops_per_chip, mfu, ...).
+    - ``plans_dir``: artifact directory; default ``<project_dir>/plans``.
+    - ``use_cache``: load a cached plan for identical inputs instead of
+      re-searching (the cache key hashes every search input).
+    - ``calibrate_after``: telemetry writes measured-vs-predicted step time
+      and peak HBM into the plan after this many steps (0 disables).
+    - ``apply_remat`` / ``apply_microbatches``: let the resolved plan flip
+      ``config.remat`` on the prepared module / set gradient accumulation to
+      the plan's microbatch count. Disable to treat the plan as advisory.
+    """
+
+    enabled: bool = True
+    hbm_gib: float = 16.0
+    seq: int = 2048
+    per_chip_batch: int = 1
+    optimizer: str = "adamw"
+    axes: tuple = ("dp_replicate", "dp_shard", "tp")
+    pinned: Optional[dict] = None
+    bandwidths: Optional[dict] = None
+    plans_dir: Optional[str] = None
+    use_cache: bool = True
+    calibrate_after: int = 10
+    apply_remat: bool = True
+    apply_microbatches: bool = True
+
+    def __post_init__(self):
+        if self.hbm_gib <= 0:
+            raise ValueError(f"hbm_gib must be > 0, got {self.hbm_gib}")
+        if self.seq < 1 or self.per_chip_batch < 1:
+            raise ValueError("seq and per_chip_batch must be >= 1")
+        from ..planner import ALL_SEARCH_AXES
+
+        bad = set(self.axes) - set(ALL_SEARCH_AXES)
+        if bad:
+            raise ValueError(
+                f"unknown search axes {sorted(bad)}; valid: {list(ALL_SEARCH_AXES)}"
+            )
+
+
+@dataclass
 class ServingConfig(KwargsHandler):
     """Continuous-batching serving engine config (serving.py). OFF by
     default everywhere: nothing constructs a

@@ -35,11 +35,17 @@ GiB = 1024 ** 3
 def build_abstract_mesh(parallelism_config) -> AbstractMesh:
     """AbstractMesh with the trainer's canonical axis order (so the planner
     produces identical specs to ParallelismConfig.build_mesh's real mesh)."""
+    import inspect
+
     from ..parallelism_config import MESH_AXIS_ORDER
 
     cfg = parallelism_config
     names = ("pp",) + MESH_AXIS_ORDER
     shape = (cfg.pp_size,) + tuple(cfg.axis_size(ax) for ax in MESH_AXIS_ORDER)
+    # jax moved AbstractMesh from (axis_sizes, axis_names) to a single
+    # ((name, size), ...) shape_tuple around 0.4.36; support both.
+    if "shape_tuple" in inspect.signature(AbstractMesh.__init__).parameters:
+        return AbstractMesh(tuple(zip(names, shape)))
     return AbstractMesh(shape, names)
 
 
@@ -175,6 +181,38 @@ def _activation_model(cfg, per_chip_batch: int, seq_local: int,
     return per_layer * L + peak, logits
 
 
+def activation_bytes(
+    cfg,
+    per_chip_batch: int,
+    seq_local: int,
+    compute_bytes: int,
+    *,
+    remat: Optional[bool] = None,
+    remat_policy: Optional[str] = None,
+) -> tuple[int, int]:
+    """(saved_bytes, logits_bytes) of the closed-form activation model, with
+    optional remat overrides so callers (the auto-parallelism planner's
+    remat-escalation ladder, planner.py) can walk the none → selective →
+    full ladder without rebuilding the module per rung."""
+    if remat is not None or remat_policy is not None:
+        cfg = dataclasses.replace(
+            cfg,
+            remat=cfg.remat if remat is None else remat,
+            remat_policy=cfg.remat_policy if remat_policy is None else remat_policy,
+        )
+    return _activation_model(cfg, per_chip_batch, seq_local, compute_bytes)
+
+
+def abstract_param_shapes(module) -> Any:
+    """Abstract (ShapeDtypeStruct) param tree of ``module`` — one eval_shape,
+    no FLOPs, no memory. Split out so the planner can score many candidate
+    topologies against a single shape tree."""
+    ids = jax.ShapeDtypeStruct((1, 8), np.int32)
+    return jax.eval_shape(
+        lambda r, i: module.init(r, i), jax.random.key(0), ids
+    )["params"]
+
+
 def estimate_per_chip(
     module,
     cfg,
@@ -188,21 +226,21 @@ def estimate_per_chip(
     fsdp_plugin=None,
     tp_rules: Optional[list] = None,
     mesh=None,
+    param_shapes: Any = None,
 ) -> tuple[MemoryEstimate, Any, Any]:
     """Per-chip HBM estimate for training ``module`` under the given
     topology. Returns (estimate, param_shapes, param_shardings) so callers
-    (the 7B dryrun) can reuse the plan.
+    (the 7B dryrun, the auto-parallelism planner) can reuse the plan.
 
     ``mesh`` may be a real Mesh; defaults to an AbstractMesh built from
-    ``parallelism_config`` — identical specs either way.
+    ``parallelism_config`` — identical specs either way. ``param_shapes``
+    skips the eval_shape when the caller already has the abstract tree
+    (the planner scores dozens of topologies against one tree).
     """
     from ..parallel.sharding import infer_opt_state_sharding, plan_parameter_sharding
 
     mesh = mesh if mesh is not None else build_abstract_mesh(parallelism_config)
-    ids = jax.ShapeDtypeStruct((1, 8), np.int32)
-    shapes = jax.eval_shape(
-        lambda r, i: module.init(r, i), jax.random.key(0), ids
-    )["params"]
+    shapes = param_shapes if param_shapes is not None else abstract_param_shapes(module)
     shardings = plan_parameter_sharding(
         shapes, mesh, fsdp_plugin=fsdp_plugin,
         parallelism_config=parallelism_config, tp_rules=tp_rules,

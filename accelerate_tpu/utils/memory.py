@@ -53,6 +53,28 @@ def get_device_memory_stats(device=None) -> dict:
         return {}
 
 
+def live_bytes_on_device(device=None):
+    """Bytes of live jax.Arrays resident on ``device`` — the fallback gauge
+    for backends whose ``memory_stats()`` is None (the virtual CPU mesh).
+    Counts committed array shards only (not executable workspace), so it
+    tracks the persistent tensor state the memory planner prices. Returns
+    None when the live-array census is unavailable."""
+    device = device or jax.devices()[0]
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return None
+    total = 0
+    for arr in arrays:
+        try:
+            for shard in arr.addressable_shards:
+                if shard.device == device and shard.data is not None:
+                    total += shard.data.nbytes
+        except Exception:
+            continue
+    return total
+
+
 def should_reduce_batch_size(exception: Exception) -> bool:
     """Heuristically detect an XLA out-of-memory failure
     (reference: utils/memory.py:82-100 checks CUDA OOM strings)."""

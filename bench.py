@@ -336,6 +336,18 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 for k in ("saves", "save_s", "verify_s", "retries",
                           "torn_skipped", "rollbacks")
             }
+        # Auto-parallelism plan block (planner.py via telemetry.note_plan):
+        # predicted vs measured step time / peak HBM + calibration state —
+        # rows carry it so cost-model drift shows up in the perf trajectory.
+        if t.get("plan"):
+            pl = t["plan"]
+            result["telemetry"]["plan"] = {
+                k: pl.get(k)
+                for k in ("layout", "predicted_step_s", "measured_step_p50_s",
+                          "step_time_ratio", "predicted_hbm_gib",
+                          "measured_peak_hbm_gib", "hbm_ratio", "calibrated",
+                          "mfu_effective")
+            }
         # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py via
         # telemetry.record_serving): rows carry it like the checkpoint and
         # compile blocks so serving-throughput regressions show up in the

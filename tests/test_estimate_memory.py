@@ -105,6 +105,93 @@ def test_7b_v5e64_fits_hbm_abstractly():
     assert est.total_gib < 16, est.rows()
 
 
+def test_estimate_ep_axis_moe_sharding():
+    """MoE expert weights shard their expert dim over ep_axes: ep=2 riding
+    dp_shard must halve the per-chip expert bytes vs the same layout with
+    ep=1 (experts replicated across dp_shard for params... no — FSDP shards
+    them anyway; compare against a pure dp_replicate layout where ep is the
+    only thing sharding them)."""
+    from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM, mixtral_tp_rules
+
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    module = MixtralForCausalLM(cfg)
+
+    # Baseline: pure replication (DDP) — experts fully replicated.
+    pc0 = ParallelismConfig(dp_replicate_size=8)
+    est0, shapes0, _ = estimate_per_chip(module, cfg, pc0, seq=16)
+
+    # ep=2 borrowing the dp_shard axis: expert dim sharded 2-way. Keep FSDP
+    # off the comparison by pinning min size high via no tp rules... the
+    # dp_shard axis also FSDP-shards, so compare ep=2 rules against the SAME
+    # mesh without ep rules: only the rule table differs.
+    pc = ParallelismConfig(dp_replicate_size=4, dp_shard_size=2, ep_size=2)
+    assert pc.ep_axes == ("dp_shard",)
+    rules_ep = mixtral_tp_rules(cfg.scan_layers, ep_axes=pc.ep_axes)
+    est_ep, shapes, shardings_ep = estimate_per_chip(
+        module, cfg, pc, seq=16, tp_rules=rules_ep
+    )
+    est_noep, _, _ = estimate_per_chip(module, cfg, pc, seq=16)
+    # Expert tensors dominate tiny-mixtral params; ep sharding must shrink
+    # per-chip bytes vs both baselines.
+    assert est_ep.params_gib < est0.params_gib
+    assert est_ep.params_gib <= est_noep.params_gib
+    # The expert leaves really carry the ep axis in their spec.
+    mesh = build_abstract_mesh(pc)
+    from jax.sharding import NamedSharding
+
+    ep_specs = [
+        sh.spec for sh in jax.tree_util.tree_leaves(
+            shardings_ep, is_leaf=lambda x: isinstance(x, NamedSharding)
+        )
+        if any("dp_shard" in str(e) for e in sh.spec)
+    ]
+    assert ep_specs, "no leaf sharded over the ep (dp_shard) axis"
+
+
+def test_estimate_moments_dtype_override():
+    """moments_dtype=bf16 halves optimizer-state bytes vs fp32 masters while
+    params/grads stay untouched (the planner's memory ladder leans on it)."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    pc = ParallelismConfig(dp_shard_size=8)
+    est_fp32, _, _ = estimate_per_chip(
+        module, cfg, pc, seq=16, optimizer="adamw", master_dtype=jnp.float32
+    )
+    est_bf16, _, _ = estimate_per_chip(
+        module, cfg, pc, seq=16, optimizer="adamw",
+        master_dtype=jnp.float32, moments_dtype=jnp.bfloat16,
+    )
+    assert est_bf16.params_gib == est_fp32.params_gib
+    assert est_bf16.grads_gib == est_fp32.grads_gib
+    assert est_bf16.opt_state_gib == pytest.approx(est_fp32.opt_state_gib / 2)
+
+
+def test_abstract_vs_real_mesh_spec_equality():
+    """The deviceless AbstractMesh plan must equal the real-Mesh plan spec
+    for spec and bytes — the property that lets a laptop plan a pod."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    from accelerate_tpu.models import llama_tp_rules
+
+    pc = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    rules = llama_tp_rules(cfg.scan_layers)
+    est_abs, shapes_abs, sh_abs = estimate_per_chip(
+        module, cfg, pc, seq=16, tp_rules=rules
+    )
+    real_mesh = pc.build_mesh(jax.devices())
+    est_real, shapes_real, sh_real = estimate_per_chip(
+        module, cfg, pc, seq=16, tp_rules=rules, mesh=real_mesh
+    )
+    from jax.sharding import NamedSharding
+
+    leaf = lambda x: isinstance(x, NamedSharding)
+    specs_abs = [s.spec for s in jax.tree_util.tree_leaves(sh_abs, is_leaf=leaf)]
+    specs_real = [s.spec for s in jax.tree_util.tree_leaves(sh_real, is_leaf=leaf)]
+    assert specs_abs == specs_real
+    assert est_abs.params_gib == est_real.params_gib
+    assert est_abs.opt_state_gib == est_real.opt_state_gib
+
+
 def test_estimate_cli_parallelism(capsys):
     from accelerate_tpu.commands.estimate import estimate_command
 

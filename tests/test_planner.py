@@ -1,0 +1,518 @@
+"""Auto-parallelism planner: enumeration constraints, cost-model
+monotonicity, the remat/microbatch escalation ladder, plan artifacts
+(determinism, roundtrip, version guard, calibration write-back), the CLI
+table, and the Accelerator auto path (resolution, cache, default-off)."""
+
+import argparse
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.planner import (
+    BandwidthTable,
+    ModelProfile,
+    ParallelPlan,
+    Planner,
+    PlannerError,
+    PlanVersionError,
+    enumerate_layouts,
+    layout_str,
+    predict_step_time,
+    record_calibration,
+)
+
+TINY_PROFILE = ModelProfile(
+    params=500_000, hidden=128, heads=4, kv_heads=2, layers=2,
+    intermediate=384, vocab=256, label="tiny",
+)
+
+
+def _tiny_planner(**kw):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    defaults = dict(n_devices=8, hbm_gib=16.0, seq=64, per_chip_batch=1,
+                    label="llama:tiny")
+    defaults.update(kw)
+    return Planner(module, cfg, **defaults)
+
+
+# ----------------------------------------------------------------------
+# Enumeration
+# ----------------------------------------------------------------------
+
+def test_enumerate_covers_devices_and_divisibility():
+    cands = enumerate_layouts(8, TINY_PROFILE, seq=64)
+    assert cands, "no candidates on 8 devices"
+    for pc in cands:
+        assert pc.total_size == 8
+        assert TINY_PROFILE.heads % pc.tp_size == 0
+        assert TINY_PROFILE.kv_heads % pc.tp_size == 0
+        assert TINY_PROFILE.layers % pc.pp_size == 0
+        assert 64 % pc.cp_size == 0
+
+
+def test_enumerate_head_constraint_prunes_tp():
+    # kv_heads=2 → tp>2 impossible even though heads=4 would allow tp=4.
+    tps = {pc.tp_size for pc in enumerate_layouts(8, TINY_PROFILE, seq=64)}
+    assert tps == {1, 2}
+
+
+def test_enumerate_layer_constraint_prunes_pp():
+    # layers=2 → pp in {1, 2}; pp=4/8 pruned.
+    pps = {pc.pp_size for pc in enumerate_layouts(8, TINY_PROFILE, seq=64)}
+    assert pps == {1, 2}
+
+
+def test_enumerate_seq_constraint_prunes_cp():
+    # seq=4 → cp in {1, 2, 4}; cp=8 pruned.
+    cps = {pc.cp_size for pc in enumerate_layouts(8, TINY_PROFILE, seq=4)}
+    assert cps == {1, 2, 4}
+
+
+def test_enumerate_expert_constraint():
+    moe = dataclasses.replace(TINY_PROFILE, experts=4)
+    cands = enumerate_layouts(8, moe, seq=64)
+    eps = {pc.ep_size for pc in cands}
+    assert eps == {1, 2, 4}
+    for pc in cands:
+        assert moe.experts % pc.ep_size == 0
+        pc.ep_axes  # must be expressible as whole axes (raises otherwise)
+    # Dense model: ep never enumerated.
+    assert {pc.ep_size for pc in enumerate_layouts(8, TINY_PROFILE, seq=64)} == {1}
+
+
+def test_enumerate_pinned_axis():
+    cands = enumerate_layouts(8, TINY_PROFILE, seq=64, pinned={"tp": 2})
+    assert cands and all(pc.tp_size == 2 for pc in cands)
+    # Impossible pin → dedicated error naming the constraint context.
+    with pytest.raises(PlannerError):
+        enumerate_layouts(8, TINY_PROFILE, seq=64, pinned={"tp": 8})
+    with pytest.raises(PlannerError):
+        enumerate_layouts(8, TINY_PROFILE, seq=64, pinned={"bogus": 2})
+
+
+def test_enumerate_restricted_axes():
+    cands = enumerate_layouts(8, TINY_PROFILE, seq=64,
+                              axes=("dp_replicate", "dp_shard"))
+    assert all(pc.tp_size == 1 and pc.cp_size == 1 and pc.pp_size == 1
+               for pc in cands)
+    layouts = {(pc.dp_replicate_size, pc.dp_shard_size) for pc in cands}
+    assert (1, 8) in layouts and (8, 1) in layouts and (2, 4) in layouts
+
+
+# ----------------------------------------------------------------------
+# Cost model
+# ----------------------------------------------------------------------
+
+def test_cost_more_tp_more_collective_bytes():
+    bw = BandwidthTable()
+    prof = dataclasses.replace(TINY_PROFILE, heads=8, kv_heads=8)
+    byte_counts = []
+    for tp in (1, 2, 4, 8):
+        pc = ParallelismConfig(tp_size=tp)
+        cost = predict_step_time(prof, pc, bw, seq=64, per_chip_batch=1)
+        byte_counts.append(cost.tp_bytes)
+    assert byte_counts[0] == 0
+    assert byte_counts == sorted(byte_counts)
+    assert byte_counts[-1] > byte_counts[1] > 0
+
+
+def test_cost_more_dp_shard_less_hbm():
+    planner = _tiny_planner()
+    rows2 = planner._memory_estimate(
+        ParallelismConfig(dp_replicate_size=4, dp_shard_size=2), False, "flash", 1
+    )
+    rows8 = planner._memory_estimate(
+        ParallelismConfig(dp_shard_size=8), False, "flash", 1
+    )
+    assert rows8["params_gib"] < rows2["params_gib"]
+    assert rows8["opt_state_gib"] < rows2["opt_state_gib"]
+    assert rows8["total_gib"] < rows2["total_gib"]
+
+
+def test_cost_pp_bubble_shrinks_with_microbatches():
+    bw = BandwidthTable(microbatch_overhead_s=0.0)
+    pc = ParallelismConfig(dp_shard_size=4, pp_size=2)
+    prof = dataclasses.replace(TINY_PROFILE, params=10**9)
+    costs = [
+        predict_step_time(prof, pc, bw, seq=64, per_chip_batch=1, microbatches=m)
+        for m in (2, 4, 8)
+    ]
+    bubbles = [c.bubble_fraction for c in costs]
+    assert bubbles == sorted(bubbles, reverse=True)
+    assert costs[0].step_s > costs[-1].step_s  # bubble dominates at m=pp
+    # With per-microbatch overhead, m → ∞ stops paying.
+    bw2 = BandwidthTable(microbatch_overhead_s=1.0)
+    c_small = predict_step_time(prof, pc, bw2, seq=64, per_chip_batch=1, microbatches=2)
+    c_huge = predict_step_time(prof, pc, bw2, seq=64, per_chip_batch=1, microbatches=64)
+    assert c_huge.microbatch_overhead_s > c_small.microbatch_overhead_s
+
+
+def test_cost_compute_is_layout_invariant():
+    bw = BandwidthTable()
+    prof = dataclasses.replace(TINY_PROFILE, heads=8, kv_heads=8)
+    c1 = predict_step_time(prof, ParallelismConfig(dp_shard_size=8), bw,
+                           seq=64, per_chip_batch=1)
+    c2 = predict_step_time(prof, ParallelismConfig(dp_shard_size=4, tp_size=2),
+                           bw, seq=64, per_chip_batch=1)
+    assert c1.compute_s == pytest.approx(c2.compute_s)
+
+
+def test_bandwidth_table_roundtrip_and_validation():
+    bw = BandwidthTable(ici_gbps=45.0, mfu=0.35)
+    assert BandwidthTable.from_dict(bw.to_dict()) == bw
+    assert BandwidthTable.from_dict(None) == BandwidthTable()
+    with pytest.raises(ValueError, match="unknown BandwidthTable field"):
+        BandwidthTable.from_dict({"warp_speed": 9})
+
+
+# ----------------------------------------------------------------------
+# Escalation ladder & over-budget
+# ----------------------------------------------------------------------
+
+def test_remat_escalation_ladder():
+    """Tighter budgets escalate: no remat → selective → full; an absurd
+    budget leaves every rung over budget (best-effort plan)."""
+    generous = _tiny_planner(hbm_gib=16.0).search()
+    assert generous.remat is False and not generous.over_budget
+
+    planner = _tiny_planner()
+    pc = ParallelismConfig(dp_shard_size=8)
+    none_rows = planner._memory_estimate(pc, False, "flash", 1)
+    sel_rows = planner._memory_estimate(pc, True, "flash", 1)
+    full_rows = planner._memory_estimate(pc, True, "minimal", 1)
+    assert full_rows["activations_gib"] < sel_rows["activations_gib"] \
+        < none_rows["activations_gib"]
+
+    # Budget squeezed between the selective and no-remat activation rows →
+    # the ladder lands on a remat rung for this layout.
+    squeeze = sel_rows["total_gib"] + (
+        none_rows["total_gib"] - sel_rows["total_gib"]
+    ) / 2
+    tight = _tiny_planner(hbm_gib=squeeze, axes=("dp_shard",),
+                          pinned={"dp_shard": 8}).search()
+    assert tight.remat is True and not tight.over_budget
+
+
+def test_over_budget_best_effort_plan(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="accelerate_tpu.planner"):
+        plan = _tiny_planner(hbm_gib=1e-9).search()
+    assert plan.over_budget is True
+    assert any("best-effort" in r.message for r in caplog.records)
+    # Best effort = the minimum-HBM point; every rejection is over budget too.
+    for rej in plan.rejections:
+        if rej.get("layout") is not None:
+            assert "over_budget" in rej["reason"]
+            assert rej["predicted_hbm_gib"] >= plan.predicted_hbm_gib
+
+
+def test_microbatch_escalation_subdivides_batch():
+    planner = _tiny_planner(per_chip_batch=8)
+    pc = ParallelismConfig(dp_shard_size=8)
+    m1 = planner._memory_estimate(pc, True, "minimal", 1)
+    m8 = planner._memory_estimate(pc, True, "minimal", 8)
+    assert m8["activations_gib"] < m1["activations_gib"]
+    assert 8 in planner._microbatch_ladder(pc)
+
+
+# ----------------------------------------------------------------------
+# Plan artifact
+# ----------------------------------------------------------------------
+
+def test_plan_json_roundtrip_and_determinism():
+    p1 = _tiny_planner().search()
+    p2 = _tiny_planner().search()
+    assert p1.to_json() == p2.to_json()  # byte-identical
+    rt = ParallelPlan.from_json(p1.to_json())
+    assert rt == p1
+    assert rt.to_parallelism_config().total_size == 8
+
+
+def test_plan_version_guard():
+    plan = _tiny_planner().search()
+    d = plan.to_json_dict()
+    d["version"] = 99
+    with pytest.raises(PlanVersionError, match="version 99"):
+        ParallelPlan.from_json_dict(d)
+
+
+def test_plan_cache_roundtrip_no_research(tmp_path):
+    planner = _tiny_planner()
+    plan1, path1, cached1 = planner.resolve(str(tmp_path))
+    assert cached1 is False and planner.searches == 1
+    assert os.path.exists(path1)
+
+    planner2 = _tiny_planner()
+    plan2, path2, cached2 = planner2.resolve(str(tmp_path))
+    assert cached2 is True and planner2.searches == 0  # no re-search
+    assert path2 == path1 and plan2.layout == plan1.layout
+
+    # Different inputs → different key → fresh search.
+    planner3 = _tiny_planner(seq=128)
+    _, path3, cached3 = planner3.resolve(str(tmp_path))
+    assert cached3 is False and path3 != path1
+
+
+def test_calibration_write_back(tmp_path):
+    planner = _tiny_planner()
+    plan, path, _ = planner.resolve(str(tmp_path))
+    cal = record_calibration(
+        path, measured_step_s=plan.predicted_step_s * 2,
+        measured_peak_hbm_gib=plan.predicted_hbm_gib * 0.5, steps=10,
+    )
+    assert cal["runs"] == 1 and cal["steps"] == 10
+    assert cal["step_time_ratio"] == pytest.approx(2.0)
+    assert cal["hbm_ratio"] == pytest.approx(0.5)
+    # 2x slower than predicted → the effective MFU halves.
+    assert cal["mfu_effective"] == pytest.approx(
+        plan.bandwidths["mfu"] / 2, rel=1e-4
+    )
+    # Second run blends (running mean) and increments runs.
+    cal2 = record_calibration(
+        path, measured_step_s=plan.predicted_step_s * 4, steps=10,
+    )
+    assert cal2["runs"] == 2 and cal2["steps"] == 20
+    assert cal2["step_time_ratio"] == pytest.approx(3.0)
+    # The artifact on disk carries it and a cache hit feeds mfu back.
+    reloaded = ParallelPlan.load(path)
+    assert reloaded.calibration["runs"] == 2
+    planner4 = _tiny_planner()
+    planner4.resolve(str(tmp_path))
+    assert planner4.bandwidths.mfu == pytest.approx(cal2["mfu_effective"])
+
+    # Calibration on a missing file is a no-op, not a crash.
+    assert record_calibration(str(tmp_path / "nope.json"),
+                              measured_step_s=1.0) is None
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+def _run_cli(argv):
+    from accelerate_tpu.commands.accelerate_cli import build_parser
+
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+def test_cli_plan_table(capsys):
+    rc = _run_cli(["plan", "llama:tiny", "--devices", "8", "--seq", "64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "chosen" in out and "rank" in out and "HBM (GiB)" in out
+    assert "slower" in out or "over_budget" in out
+
+
+def test_cli_plan_json_and_artifact(tmp_path, capsys):
+    out_path = str(tmp_path / "plan.json")
+    rc = _run_cli(["plan", "llama:tiny", "--devices", "8", "--seq", "64",
+                   "--json", "--out", out_path])
+    printed = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(printed)
+    assert payload["version"] == 1 and payload["n_devices"] == 8
+    # The artifact is loadable and identical to stdout.
+    plan = ParallelPlan.load(out_path)
+    assert plan.to_json_dict() == payload
+
+
+def test_cli_plan_pinned_axis_override(tmp_path, capsys):
+    out_path = str(tmp_path / "plan.json")
+    rc = _run_cli(["plan", "llama:tiny", "--devices", "8", "--seq", "64",
+                   "--pin", "tp=2", "--json", "--out", out_path])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["layout"]["tp"] == 2
+    for rej in payload["rejections"]:
+        if rej.get("layout") is not None:
+            assert rej["layout"]["tp"] == 2
+    # Impossible pin → clean CLI error, not a traceback.
+    rc2 = _run_cli(["plan", "llama:tiny", "--devices", "8", "--seq", "64",
+                    "--pin", "tp=8"])
+    assert rc2 == 2
+
+
+def test_cli_estimate_memory_plan_flag(tmp_path, capsys):
+    out_path = str(tmp_path / "plan.json")
+    _run_cli(["plan", "llama:tiny", "--devices", "8", "--seq", "64",
+              "--out", out_path])
+    capsys.readouterr()
+    rc = _run_cli(["estimate-memory", "llama:tiny", "--dtypes", "fp32",
+                   "--plan", out_path, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["seq"] == 64  # shape came from the plan, not the default
+    assert payload["per_chip"]["fits"] is True
+    # Colon syntax + dp alias (satellite): 'dp:2,tp:4' parses.
+    from accelerate_tpu.commands.estimate import _parse_parallelism
+
+    pc = _parse_parallelism("dp:2,tp:4")
+    assert pc.dp_shard_size == 2 and pc.tp_size == 4
+
+
+# ----------------------------------------------------------------------
+# Accelerator wiring
+# ----------------------------------------------------------------------
+
+def _reset_state():
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def _prepare_auto(tmp_path, **handler_kw):
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.utils import AutoPlanKwargs, set_seed
+
+    _reset_state()
+    set_seed(0)
+    defaults = dict(hbm_gib=16.0, seq=32, per_chip_batch=1)
+    defaults.update(handler_kw)
+    acc = Accelerator(
+        parallelism_config="auto",
+        project_dir=str(tmp_path),
+        kwargs_handlers=[AutoPlanKwargs(**defaults)],
+    )
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    ids = np.zeros((8, 9), np.int32)
+    model = Model.from_flax(module, jax.random.key(0), ids)
+    model, _ = acc.prepare(model, optax.adamw(1e-3))
+    return acc, model
+
+
+def test_accelerator_auto_resolves_and_caches(tmp_path):
+    acc, _ = _prepare_auto(tmp_path)
+    assert acc.active_plan is not None
+    assert acc.active_plan_meta["from_cache"] is False
+    assert os.path.exists(acc.active_plan_meta["path"])
+    assert acc.parallelism_config is not None
+    assert acc.parallelism_config.total_size == 8
+    assert acc.mesh is not None
+    # The installed mesh matches the plan's layout.
+    for ax in ("dp_shard", "tp"):
+        assert acc.mesh.shape[ax] == acc.active_plan.layout[ax]
+
+    acc2, _ = _prepare_auto(tmp_path)
+    assert acc2.active_plan_meta["from_cache"] is True
+    assert acc2.active_plan.layout == acc.active_plan.layout
+
+
+def test_accelerator_auto_pinned(tmp_path):
+    acc, model = _prepare_auto(tmp_path, pinned={"tp": 2})
+    assert acc.active_plan.layout["tp"] == 2
+    assert acc.mesh.shape["tp"] == 2
+    # The plan's TP rule table was installed so params really shard.
+    assert model.tp_rules
+
+
+def test_accelerator_default_off(tmp_path):
+    """No AutoPlanKwargs, no "auto": the planner never runs — no plans dir,
+    no active plan, parallelism_config untouched (the pinned default-off
+    contract every subsystem follows)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+
+    _reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    assert acc.active_plan is None and acc.active_plan_meta is None
+    assert acc.auto_plan_handler is None and acc._auto_plan_pending is False
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Model.from_flax(
+        LlamaForCausalLM(cfg), jax.random.key(0), np.zeros((8, 9), np.int32)
+    )
+    acc.prepare(model, optax.adamw(1e-3))
+    assert acc.active_plan is None
+    assert not os.path.exists(os.path.join(str(tmp_path), "plans"))
+
+
+def test_accelerator_explicit_config_wins(tmp_path):
+    """AutoPlanKwargs + an explicit ParallelismConfig → the explicit config
+    is honored and the planner defers (warning, no artifact)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.utils import AutoPlanKwargs
+
+    _reset_state()
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        kwargs_handlers=[AutoPlanKwargs(seq=32)],
+    )
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Model.from_flax(
+        LlamaForCausalLM(cfg), jax.random.key(0), np.zeros((8, 9), np.int32)
+    )
+    acc.prepare(model, optax.adamw(1e-3))
+    assert acc.active_plan is None
+    assert acc.parallelism_config.dp_shard_size == 8
+
+
+def test_accelerator_bad_auto_string():
+    from accelerate_tpu import Accelerator
+
+    _reset_state()
+    with pytest.raises(ValueError, match="'auto'"):
+        Accelerator(parallelism_config="automagic")
+
+
+def test_auto_plan_kwargs_validation():
+    from accelerate_tpu.utils import AutoPlanKwargs
+
+    with pytest.raises(ValueError):
+        AutoPlanKwargs(hbm_gib=0)
+    with pytest.raises(ValueError):
+        AutoPlanKwargs(seq=0)
+    with pytest.raises(ValueError, match="unknown search axes"):
+        AutoPlanKwargs(axes=("dp_shard", "warp"))
+
+
+def test_telemetry_plan_block_and_calibration(tmp_path):
+    """note_plan → summary 'plan' block; calibration lands in the artifact
+    after calibrate_after steps (driven through the real recorder)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.telemetry import TelemetryRecorder
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    _reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    rec = TelemetryRecorder(
+        acc, TelemetryKwargs(log_every=0, straggler_probe_every=0)
+    )
+    plan, path, _ = _tiny_planner().resolve(str(tmp_path))
+    rec.note_plan(plan.to_json_dict(), path, calibrate_after=3)
+
+    def fake_step(state, batch):
+        return state
+
+    batch = {"input_ids": np.zeros((8, 32), np.int32)}
+    for _ in range(4):
+        rec.on_train_step(fake_step, batch, wall_s=0.01)
+    block = rec.summary()["plan"]
+    assert block["layout"] == plan.layout
+    assert block["calibrated"] is True
+    assert block["measured_step_p50_s"] == pytest.approx(0.01)
+    cal = ParallelPlan.load(path).calibration
+    assert cal and cal["runs"] == 1 and cal["measured_step_s"] == pytest.approx(0.01)
+    rec.close()
+
+
+def test_layout_str():
+    assert layout_str({"dp_shard": 8, "tp": 1}) == "dp_shard=8"
+    assert layout_str({"tp": 1}) == "single-device"

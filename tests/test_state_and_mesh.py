@@ -40,6 +40,36 @@ def test_parallelism_config_infer():
     assert mesh.shape["dp_shard"] == 4  # auto-filled to cover 8 devices
 
 
+def test_parallelism_config_infer_oversubscribed():
+    """Fixed product EXCEEDS the device count → the dedicated
+    oversubscription error naming each offending axis and its env var — not
+    the misleading 'does not divide' message."""
+    from accelerate_tpu import ParallelismConfig, ParallelismOversubscriptionError
+
+    cfg = ParallelismConfig(dp_shard_size=4, tp_size=4)  # 16 > 8 devices
+    with pytest.raises(ParallelismOversubscriptionError) as exc:
+        cfg.infer_missing_axis(8)
+    msg = str(exc.value)
+    assert "dp_shard=4" in msg and "tp=4" in msg
+    assert "PARALLELISM_CONFIG_DP_SHARD_SIZE" in msg
+    assert "PARALLELISM_CONFIG_TP_SIZE" in msg
+    assert "does not divide" not in msg
+    # Still a ValueError subclass — existing handlers keep working.
+    assert isinstance(exc.value, ValueError)
+
+
+def test_parallelism_config_infer_nondividing():
+    """Fixed product below the device count but not dividing it → the
+    original 'does not divide' error (NOT the oversubscription one)."""
+    from accelerate_tpu import ParallelismConfig, ParallelismOversubscriptionError
+
+    cfg = ParallelismConfig(tp_size=3)
+    with pytest.raises(ValueError) as exc:
+        cfg.infer_missing_axis(8)
+    assert "does not divide" in str(exc.value)
+    assert not isinstance(exc.value, ParallelismOversubscriptionError)
+
+
 def test_parallelism_config_validation():
     from accelerate_tpu import ParallelismConfig
 
