@@ -6,7 +6,8 @@ PYTEST = $(ENV) python -m pytest -q
 
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
-        telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke
+        telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
+        reshard-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -105,6 +106,16 @@ plan-smoke:
 # loss matches an uninterrupted run. See docs/usage_guides/fault_tolerance.md.
 faulttol-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.faulttol_smoke
+
+# Elastic-resharding gate: preempt a 4-way training worker, then resume its
+# checkpoint on 2-way AND 8-way meshes with ACCELERATE_RESTART_ATTEMPT=1.
+# Each resume must restore through the planned collective schedule (no
+# host-staged leaves within the staging budget), report the telemetry
+# `reshard` block, and finish with the uninterrupted run's final loss. See
+# docs/usage_guides/elastic_resharding.md. (The driver pins each child's
+# device count itself, so this target sets no XLA_FLAGS.)
+reshard-smoke:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu.test_utils.scripts.reshard_smoke
 
 # Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
 # relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8

@@ -83,7 +83,16 @@ from .generation import (  # noqa: E402
     sample_logits,
 )
 from .serving import ServingEngine  # noqa: E402
-from .utils.dataclasses import AutoPlanKwargs, ServingConfig  # noqa: E402
+from .utils.dataclasses import AutoPlanKwargs, ElasticKwargs, ServingConfig  # noqa: E402
+from .resharding import (  # noqa: E402
+    ElasticManager,
+    ReshardExecutor,
+    ReshardSchedule,
+    TopologyMismatchError,
+    read_plan_manifest,
+    schedule_from_manifest,
+    write_plan_manifest,
+)
 from .planner import (  # noqa: E402
     BandwidthTable,
     ModelProfile,

@@ -78,6 +78,7 @@ from .utils.dataclasses import (
     AutoPlanKwargs,
     CompileKwargs,
     DistributedDataParallelKwargs,
+    ElasticKwargs,
     FaultToleranceKwargs,
     KwargsHandler,
     ProfileKwargs,
@@ -198,6 +199,7 @@ class Accelerator:
         self.compile_handler = None
         self.fault_tolerance_handler = None
         self.auto_plan_handler = None
+        self.elastic_handler = None
         # Serving config (serving.py): stored only — no serving code runs on
         # the training path; build_serving_engine constructs the engine.
         self.serving_config = None
@@ -220,6 +222,8 @@ class Accelerator:
                 self.serving_config = handler
             elif isinstance(handler, AutoPlanKwargs):
                 self.auto_plan_handler = handler
+            elif isinstance(handler, ElasticKwargs):
+                self.elastic_handler = handler
 
         if gradient_accumulation_plugin is None:
             ga_steps = int(
@@ -329,6 +333,18 @@ class Accelerator:
             from .fault_tolerance import FaultToleranceManager
 
             self.fault_tolerance = FaultToleranceManager(self, self.fault_tolerance_handler)
+
+        # Elastic resharding (resharding.py): restore a checkpoint written on
+        # a different topology through a planned redistribution schedule, and
+        # hot-swap layouts mid-run via migrate_plan(). Same contract as the
+        # managers above — off unless an ElasticKwargs handler was passed,
+        # then every hook site is a None check; without it a topology
+        # mismatch raises TopologyMismatchError instead of resharding.
+        self.elastic = None
+        if self.elastic_handler is not None and self.elastic_handler.enabled:
+            from .resharding import ElasticManager
+
+            self.elastic = ElasticManager(self, self.elastic_handler)
 
     # ------------------------------------------------------------------
     # Introspection properties (reference: accelerator.py:640-780)
@@ -773,6 +789,13 @@ class Accelerator:
             )
         from .planner import BandwidthTable, Planner, default_tp_rules, layout_str
 
+        # Elastic resize: a relaunch that came back on a different device
+        # count re-searches under the new topology, pinning what the previous
+        # run's (calibrated) plan says is winning — or, under
+        # resize_policy="keep", pinning the whole scaled layout.
+        pinned = handler.pinned
+        if not pinned:
+            pinned = self._elastic_resize_pins() or pinned
         label = f"{type(cfg).__name__}:{getattr(cfg, 'num_hidden_layers', '?')}L"
         planner = Planner(
             module,
@@ -784,7 +807,7 @@ class Accelerator:
             optimizer=handler.optimizer,
             tp_rules=model.tp_rules or default_tp_rules(module, cfg),
             axes=tuple(handler.axes),
-            pinned=handler.pinned,
+            pinned=pinned,
             bandwidths=BandwidthTable.from_dict(handler.bandwidths),
             label=label,
         )
@@ -854,6 +877,62 @@ class Accelerator:
             )
         if self.compile_manager is not None:
             self.compile_manager.note_plan(plan)
+
+    def _checkpoint_plan_layout(self) -> Optional[dict]:
+        """Layout recorded in the newest checkpoint's plan manifest, or None
+        (no checkpoints / checkpoint predates plan manifests)."""
+        base = os.path.join(self.project_dir or ".", "checkpoints")
+        if not os.path.isdir(base):
+            return None
+        from .checkpointing import _list_checkpoint_dirs
+        from .resharding import read_plan_manifest
+
+        for name in reversed(_list_checkpoint_dirs(base)):
+            manifest = read_plan_manifest(os.path.join(base, name))
+            if manifest is not None:
+                return manifest.get("layout") or None
+        return None
+
+    def _elastic_resize_pins(self) -> Optional[dict]:
+        """Planner pins for the preemption-driven resize path: only active on
+        an elastic relaunch (``ACCELERATE_RESTART_ATTEMPT`` > 0) with an
+        ElasticKwargs handler and a checkpointed source layout to learn
+        from. ``resize_policy="fail"`` pins nothing — the restore itself will
+        raise on the mismatch."""
+        elastic = self.elastic
+        attempt = int(os.environ.get("ACCELERATE_RESTART_ATTEMPT", "0") or 0)
+        if elastic is None or not elastic.enabled or attempt <= 0:
+            return None
+        if elastic.resize_policy == "fail":
+            return None
+        src_layout = self._checkpoint_plan_layout()
+        if not src_layout:
+            return None
+        from .planner import layout_str, resize_pins, scaled_layout
+
+        n_dev = len(self.state.devices)
+        pins: Optional[dict] = None
+        if elastic.resize_policy == "keep":
+            kept = scaled_layout(src_layout, n_dev)
+            if kept is not None:
+                # Pin every plannable axis: the "search" then has exactly one
+                # candidate — the old layout with dp rescaled — but still
+                # produces a normal plan artifact + telemetry.
+                pins = {
+                    ax: int(kept.get(ax, 1))
+                    for ax in ("dp_replicate", "dp_shard", "tp", "cp", "pp")
+                }
+            # Non-divisible "keep" falls through to winning-axes pinning.
+        if pins is None and getattr(elastic.handler, "pin_winning_axes", True):
+            pins = resize_pins(src_layout, n_dev) or None
+        if pins:
+            logger.info(
+                "elastic resize: restart attempt %d on %d device(s) — "
+                "planner re-search pinned to %s (checkpoint layout was %s).",
+                attempt, n_dev, pins, layout_str(src_layout),
+                main_process_only=True,
+            )
+        return pins
 
     def _apply_activation_checkpointing(self, model: Model):
         """Honor ``fsdp_plugin.activation_checkpointing`` (reference FSDP
@@ -1785,7 +1864,9 @@ class Accelerator:
                 else P(*([None] * jnp.ndim(x))),
                 batch,
             )
-            loss, grads, new_comm = jax.shard_map(
+            from .utils.environment import shard_map_compat
+
+            loss, grads, new_comm = shard_map_compat(
                 local,
                 mesh=mesh,
                 in_specs=(rep(state.params), batch_specs, comm_specs),
@@ -2102,6 +2183,158 @@ class Accelerator:
                 hook(self._models, resolved)
             input_dir = resolved
         return load_accelerator_state(self, input_dir)
+
+    def migrate_plan(self, plan) -> dict:
+        """Hot-swap the parallel layout mid-run (resharding.py).
+
+        Reshards every prepared ``TrainState`` in place onto the mesh the new
+        plan implies — leaves move through budget-bounded, donated
+        ``device_put`` batches, so peak HBM stays within the
+        :class:`~accelerate_tpu.utils.ElasticKwargs` staging budget. RNG,
+        dataloader cursors, grad-accum state, loss scale and the step counter
+        carry over untouched (they are replicated or host-side). The
+        compile-manager's executables are invalidated — the old ones were
+        specialized to the previous shardings — and re-warmed for the new
+        shapes when ``warm_after_migrate`` is on.
+
+        ``plan`` is a :class:`~accelerate_tpu.planner.ParallelPlan` or a
+        :class:`~accelerate_tpu.parallelism_config.ParallelismConfig`.
+        Requires an ElasticKwargs handler. Step functions built by
+        ``prepare_train_step`` keep working (jit retraces for the new
+        shardings), except ZeRO-2 (``SHARD_GRAD_OP``) and ``cpu_offload``
+        setups, whose steps captured the old sharding constraints — rebuild
+        those with ``prepare_train_step`` after migrating.
+
+        Returns the reshard stats dict (also recorded as the telemetry
+        ``reshard`` block)."""
+        if self.elastic is None or not self.elastic.enabled:
+            raise RuntimeError(
+                "migrate_plan requires an ElasticKwargs handler: "
+                "Accelerator(kwargs_handlers=[ElasticKwargs()])."
+            )
+        if not self._train_states:
+            raise RuntimeError("Nothing prepared; call accelerator.prepare(...) first.")
+        new_pc = (
+            plan.to_parallelism_config() if hasattr(plan, "to_parallelism_config") else plan
+        )
+        if not isinstance(new_pc, ParallelismConfig):
+            raise TypeError(
+                f"migrate_plan takes a ParallelPlan or ParallelismConfig, got {type(plan)!r}"
+            )
+        # Pause point: drain any async checkpoint writer and let in-flight
+        # steps retire before buffers start being donated out from under them.
+        if hasattr(self, "wait_for_checkpoint"):
+            self.wait_for_checkpoint()
+        jax.block_until_ready(
+            [s for st in self._train_states for s in jax.tree_util.tree_leaves(st)]
+        )
+
+        old_pc = self.state.parallelism_config
+        new_pc = new_pc.infer_missing_axis(len(self.state.devices))
+        self.state.parallelism_config = new_pc
+        self.state._mesh = None  # the mesh property rebuilds from new_pc
+        try:
+            new_mesh = self.state.mesh
+            executor = self.elastic.executor(new_mesh)
+            for slot, st in enumerate(self._train_states):
+                model = next(
+                    (m for m in self._models if getattr(m, "_state_slot", None) == slot),
+                    None,
+                )
+                if model is None:
+                    continue
+                param_shardings = plan_parameter_sharding(
+                    st.params,
+                    new_mesh,
+                    fsdp_plugin=self.fsdp_plugin,
+                    parallelism_config=new_pc,
+                    tp_rules=model.tp_rules,
+                )
+                if st.tx is not None:
+                    opt_shardings, grad_shardings, opt_offload = self._build_opt_shardings(
+                        model, st.params, param_shardings, st.tx, new_pc
+                    )
+                else:
+                    opt_shardings = ()
+                    grad_shardings, opt_offload = None, None
+                rep = replicated(new_mesh)
+                state_shardings = TrainState(
+                    step=rep,
+                    params=param_shardings,
+                    opt_state=opt_shardings,
+                    extra_state=jax.tree.map(lambda _: rep, st.extra_state)
+                    if st.extra_state
+                    else st.extra_state,
+                    accum_grads=None,
+                    loss_scale=jax.tree.map(lambda _: rep, st.loss_scale)
+                    if st.loss_scale is not None
+                    else None,
+                    apply_fn=st.apply_fn,
+                    tx=st.tx,
+                )
+                # In-flight accumulation windows migrate with everything else
+                # (grads follow the ZeRO-2 constraint when one is active).
+                migrate_shardings = state_shardings
+                if st.accum_grads is not None:
+                    migrate_shardings = state_shardings.replace(
+                        accum_grads=grad_shardings or param_shardings
+                    )
+                new_state = executor.put_tree(
+                    st, migrate_shardings, prefix=f"slot{slot}"
+                )
+                self._train_states[slot] = new_state
+                self._slot_meta[slot] = {
+                    "state_shardings": state_shardings,
+                    "param_shardings": param_shardings,
+                    "grad_shardings": grad_shardings,
+                    "opt_offload": opt_offload,
+                }
+                if slot == 0:
+                    self._state_shardings = state_shardings
+                    self._param_shardings = param_shardings
+                    self._grad_shardings = grad_shardings
+                    self._opt_offload = opt_offload
+        except Exception:
+            # Roll the topology back so a failed migration leaves a
+            # consistent (old) mesh behind; state leaves are untouched until
+            # the executor runs, and put_tree only commits whole trees.
+            self.state.parallelism_config = old_pc
+            self.state._mesh = None
+            raise
+        # Jitted-step caches are stale: old executables were compiled for the
+        # previous shardings (and donation layout).
+        self._grad_fn_cache.clear()
+        self._apply_jit = None
+        self._gradnorm_jit = None
+        if plan is not None and hasattr(plan, "to_parallelism_config"):
+            self.active_plan = plan
+            if self.telemetry is not None:
+                self.telemetry.note_plan(plan.to_json_dict(), None)
+            if self.compile_manager is not None:
+                self.compile_manager.note_plan(plan)
+        if self.compile_manager is not None:
+            dropped = self.compile_manager.invalidate_steps()
+            logger.info(
+                "migrate_plan: dropped %d stale executable(s).", dropped,
+                main_process_only=True,
+            )
+            if getattr(self.elastic.handler, "warm_after_migrate", True):
+                self.compile_manager.warmup()
+        stats = executor.stats()
+        self.elastic.note_reshard(stats, kind="migrate")
+        from .planner import _layout_dict, layout_str
+
+        logger.info(
+            "migrate_plan: %s -> %s (%d leaves, %s bytes, depth %d, %.3fs).",
+            layout_str(_layout_dict(old_pc)) if old_pc is not None else "default",
+            layout_str(_layout_dict(new_pc)),
+            stats.get("moved_leaves", 0),
+            f"{stats.get('bytes_transferred', 0):,}",
+            stats.get("depth", 0),
+            stats.get("wall_s", 0.0),
+            main_process_only=True,
+        )
+        return stats
 
     def unscale_gradients(self, optimizer=None):
         """Parity advisory (reference: accelerator.py:2928-2944 unscales the

@@ -245,6 +245,72 @@ def _load_distributed_state(accelerator, state, input_dir: str):
     )
 
 
+def _write_plan_sidecar(accelerator, write_dir: str) -> None:
+    """Topology sidecar (plan_manifest.json) for elastic restore. Managed
+    saves only — when neither fault tolerance nor elastic resharding is
+    active the unmanaged checkpoint byte layout stays untouched. Written
+    into the staging dir, so an atomic commit hashes and certifies it like
+    every other checkpoint file."""
+    ft = getattr(accelerator, "fault_tolerance", None)
+    elastic = getattr(accelerator, "elastic", None)
+    if ft is None and elastic is None:
+        return
+    try:
+        from .resharding import write_plan_manifest
+
+        write_plan_manifest(accelerator, write_dir)
+    except Exception:
+        logger.warning(
+            "failed to write plan manifest (checkpoint remains loadable on "
+            "the same topology)", exc_info=True,
+        )
+
+
+def _live_topology(accelerator) -> tuple[int, Optional[dict]]:
+    """(device count, layout dict) of the running mesh, for topology checks."""
+    n_devices = len(accelerator.state.devices)
+    pc = accelerator.state.parallelism_config
+    return n_devices, (pc.layout_dict() if pc is not None else None)
+
+
+def _reshard_executor_for_load(accelerator, input_dir: str):
+    """Topology governance at the top of a restore: compare the checkpoint's
+    plan manifest against the live mesh BEFORE any deserialization. Returns a
+    ``ReshardExecutor`` when the topologies differ and elastic restore is on;
+    ``None`` when they match (or the checkpoint predates plan manifests);
+    raises :class:`TopologyMismatchError` when they differ and elastic
+    restore is off (or ``resize_policy="fail"``)."""
+    from .resharding import (
+        raise_topology_mismatch,
+        read_plan_manifest,
+        topology_matches,
+    )
+
+    manifest = read_plan_manifest(input_dir)
+    if manifest is None:
+        return None
+    n_devices, layout = _live_topology(accelerator)
+    if topology_matches(manifest, n_devices, layout):
+        return None
+    elastic = getattr(accelerator, "elastic", None)
+    if elastic is None or not elastic.elastic_restore or elastic.resize_policy == "fail":
+        raise_topology_mismatch(manifest, n_devices, layout, input_dir)
+    from .resharding import describe_topology
+
+    logger.info(
+        "elastic restore: checkpoint topology %s -> live %s; planning "
+        "redistribution (staging budget %d MiB)",
+        describe_topology(
+            int(manifest.get("n_devices", manifest.get("world_size", 0))),
+            manifest.get("layout"),
+        ),
+        describe_topology(n_devices, layout),
+        elastic.staging_budget_bytes // (1024 * 1024),
+        main_process_only=True,
+    )
+    return elastic.executor(accelerator.state.mesh, manifest)
+
+
 def _finalize_save(accelerator, write_dir: str, final_dir: str, step_host) -> None:
     """Commit point of an atomic save + post-commit housekeeping. No-op
     (besides the iteration bump the callers keep) for legacy saves."""
@@ -354,6 +420,7 @@ def save_accelerator_state(
             block = True
         _save_distributed_state(accelerator, state, write_dir, block=block)
         _save_host_side_state(accelerator, state, write_dir)
+        _write_plan_sidecar(accelerator, write_dir)
         _finalize_save(accelerator, write_dir, output_dir, int(np.asarray(state.step)))
         _record_checkpoint_event(
             accelerator, "checkpoint_save", t_save0, output_dir,
@@ -420,6 +487,7 @@ def save_accelerator_state(
             with open(os.path.join(write_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "wb") as f:
                 pickle.dump(payload, f)
     _save_host_side_state(accelerator, state, write_dir)
+    _write_plan_sidecar(accelerator, write_dir)
 
     _finalize_save(accelerator, write_dir, output_dir, step_host)
     _record_checkpoint_event(
@@ -458,12 +526,28 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     if state is None:
         raise RuntimeError("Call accelerator.prepare(...) before load_state().")
 
+    # Topology governance: mismatch either raises (elastic off) or hands back
+    # the executor that routes every leaf through the planned redistribution.
+    resharder = _reshard_executor_for_load(accelerator, input_dir)
+    elastic = getattr(accelerator, "elastic", None)
+
     if os.path.isdir(os.path.join(input_dir, _ORBAX_DIR)):
         new_state = _load_distributed_state(accelerator, state, input_dir)
         accelerator._train_state = new_state.replace(
             loss_scale=_restore_loss_scale(state, input_dir)
         )
         _load_host_side_state(accelerator, input_dir)
+        if resharder is not None and elastic is not None:
+            # TensorStore restores straight into the live shardings (each
+            # process reads only its ranges), so the redistribution happened
+            # inside the restore — record the planned schedule for telemetry.
+            schedule = resharder.plan_tree(
+                accelerator._train_state,
+                accelerator._slot_meta[0]["state_shardings"],
+                prefix="slot0",
+            )
+            stats = dict(schedule.summary(), wall_s=round(time.perf_counter() - t_load0, 6))
+            elastic.note_reshard(stats, kind="restore-orbax")
         _record_checkpoint_event(
             accelerator, "checkpoint_load", t_load0, input_dir, format="orbax",
         )
@@ -483,9 +567,14 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
 
     params_host = _remap(jax.tree.map(lambda x: x, state.params), loaded_tree)
     shardings = accelerator._state_shardings
-    new_params = jax.tree.map(
-        lambda arr, s: jax.device_put(arr, s), params_host, shardings.params
-    )
+    if resharder is not None:
+        new_params = resharder.put_tree(
+            params_host, shardings.params, prefix="slot0/params"
+        )
+    else:
+        new_params = jax.tree.map(
+            lambda arr, s: jax.device_put(arr, s), params_host, shardings.params
+        )
 
     opt_path = os.path.join(input_dir, f"{OPTIMIZER_NAME}.bin")
     if not os.path.exists(opt_path):
@@ -500,13 +589,18 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         )
     with open(opt_path, "rb") as f:
         opt_payload = pickle.load(f)
-    new_opt = jax.tree.map(
-        lambda arr, s: jax.device_put(np.asarray(arr), s)
-        if hasattr(arr, "shape") or np.isscalar(arr)
-        else arr,
-        opt_payload["opt_state"],
-        shardings.opt_state,
-    )
+    if resharder is not None:
+        new_opt = resharder.put_tree(
+            opt_payload["opt_state"], shardings.opt_state, prefix="slot0/opt_state"
+        )
+    else:
+        new_opt = jax.tree.map(
+            lambda arr, s: jax.device_put(np.asarray(arr), s)
+            if hasattr(arr, "shape") or np.isscalar(arr)
+            else arr,
+            opt_payload["opt_state"],
+            shardings.opt_state,
+        )
     loss_scale = _restore_loss_scale(state, input_dir)
 
     import jax.numpy as jnp
@@ -515,11 +609,16 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
     loaded_extra = opt_payload.get("extra_state")
     if loaded_extra is not None and extra_state is not None:
         extra_sh = getattr(shardings, "extra_state", None)
-        extra_state = (
-            jax.tree.map(lambda a, s: jax.device_put(np.asarray(a), s), loaded_extra, extra_sh)
-            if extra_sh is not None
-            else jax.tree.map(lambda a: jnp.asarray(a), loaded_extra)
-        )
+        if extra_sh is not None and resharder is not None:
+            extra_state = resharder.put_tree(
+                loaded_extra, extra_sh, prefix="slot0/extra_state"
+            )
+        elif extra_sh is not None:
+            extra_state = jax.tree.map(
+                lambda a, s: jax.device_put(np.asarray(a), s), loaded_extra, extra_sh
+            )
+        else:
+            extra_state = jax.tree.map(lambda a: jnp.asarray(a), loaded_extra)
 
     accelerator._train_state = state.replace(
         step=jnp.asarray(opt_payload["step"], jnp.int32),
@@ -552,18 +651,26 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         slot_sh = accelerator._slot_meta[i]["state_shardings"]
         flat_i = load_sharded_safetensors(input_dir, weights_name=weights_name)
         params_i = _remap(jax.tree.map(lambda x: x, extra_st.params), unflatten_state_dict(flat_i))
-        new_params_i = jax.tree.map(
-            lambda arr, s: jax.device_put(arr, s), params_i, slot_sh.params
-        )
         with open(os.path.join(input_dir, f"{OPTIMIZER_NAME}_{i}.bin"), "rb") as f:
             payload_i = pickle.load(f)
-        new_opt_i = jax.tree.map(
-            lambda arr, s: jax.device_put(np.asarray(arr), s)
-            if hasattr(arr, "shape") or np.isscalar(arr)
-            else arr,
-            payload_i["opt_state"],
-            slot_sh.opt_state,
-        )
+        if resharder is not None:
+            new_params_i = resharder.put_tree(
+                params_i, slot_sh.params, prefix=f"slot{i}/params"
+            )
+            new_opt_i = resharder.put_tree(
+                payload_i["opt_state"], slot_sh.opt_state, prefix=f"slot{i}/opt_state"
+            )
+        else:
+            new_params_i = jax.tree.map(
+                lambda arr, s: jax.device_put(arr, s), params_i, slot_sh.params
+            )
+            new_opt_i = jax.tree.map(
+                lambda arr, s: jax.device_put(np.asarray(arr), s)
+                if hasattr(arr, "shape") or np.isscalar(arr)
+                else arr,
+                payload_i["opt_state"],
+                slot_sh.opt_state,
+            )
         extra_i = extra_st.extra_state
         if payload_i.get("extra_state") is not None and extra_i is not None:
             extra_sh_i = getattr(slot_sh, "extra_state", None)
@@ -583,6 +690,9 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
         )
 
     _load_host_side_state(accelerator, input_dir)
+
+    if resharder is not None and elastic is not None:
+        elastic.note_reshard(resharder.stats(), kind="restore")
 
     _record_checkpoint_event(
         accelerator, "checkpoint_load", t_load0, input_dir, format="safetensors",

@@ -188,11 +188,16 @@ def launch_command(args: argparse.Namespace) -> int:
                 # A preemption-triggered save completed and the workers asked
                 # for a resumable restart (fault_tolerance.py): the relaunch
                 # carries ACCELERATE_RESTART_ATTEMPT so elastic auto-resume
-                # continues from the preemption checkpoint.
+                # continues from the preemption checkpoint. If the relaunch
+                # lands on a different device count, an ElasticKwargs handler
+                # reshards the restore onto whatever came back
+                # (resharding.py); without one the mismatched load fails
+                # fast with both topologies named.
                 print(
                     f"[accelerate-tpu] attempt {attempt}: preemption save "
                     f"complete (rc={rc}); relaunching gang to resume "
-                    f"({max_restarts - attempt} restarts left)",
+                    f"({max_restarts - attempt} restarts left; a changed "
+                    f"slice size reshards under ElasticKwargs)",
                     file=sys.stderr,
                 )
             else:

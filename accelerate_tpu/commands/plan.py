@@ -12,6 +12,13 @@ Examples::
     accelerate-tpu plan llama:7b --devices 64 --hbm-gib 16 --seq 2048
     accelerate-tpu plan llama:7b --devices 64 --pin tp=8 --out plan.json
     accelerate-tpu plan llama:tiny --devices 8 --axes dp_shard,tp,pp --json
+    accelerate-tpu plan --from-checkpoint ckpts/checkpoint_12 --devices 16
+
+With ``--from-checkpoint`` the command reads the checkpoint's plan manifest
+and prints the migration schedule an elastic restore onto ``--devices``
+(optionally ``--to-layout``) would execute — per-leaf collective ops, bytes
+moved, staging batches, and a predicted transfer time from the
+BandwidthTable — without touching any devices.
 """
 
 from __future__ import annotations
@@ -41,7 +48,90 @@ def _parse_pins(spec: str) -> dict:
     return pins
 
 
+def _from_checkpoint_command(args: argparse.Namespace) -> int:
+    """Print the migration schedule an elastic restore of this checkpoint
+    would run on the requested topology — planned only, never executed."""
+    from ..planner import BandwidthTable, layout_str, scaled_layout
+    from ..resharding import (
+        describe_topology,
+        predict_transfer_s,
+        read_plan_manifest,
+        schedule_from_manifest,
+    )
+
+    manifest = read_plan_manifest(args.from_checkpoint)
+    if manifest is None:
+        print(
+            f"{args.from_checkpoint} has no readable plan manifest "
+            "(plan_manifest.json) — it was saved without fault tolerance or "
+            "elastic resharding enabled, so there is no recorded topology to "
+            "migrate from.",
+            file=sys.stderr,
+        )
+        return 2
+    n_devices = args.devices
+    if n_devices is None:
+        import jax
+
+        n_devices = len(jax.devices())
+    src_layout = manifest.get("layout") or {}
+    if args.to_layout:
+        dst_layout = _parse_pins(args.to_layout)
+    else:
+        # Same default an elastic resume uses under resize_policy="keep":
+        # hold the model axes, rescale data parallelism to the new slice.
+        dst_layout = scaled_layout(src_layout, n_devices) or {"dp_shard": n_devices}
+    budget_bytes = int(args.staging_budget_mb * 1024 * 1024)
+    schedule = schedule_from_manifest(manifest, dst_layout, budget_bytes)
+    bandwidths = BandwidthTable.from_dict(
+        json.loads(args.bandwidths) if args.bandwidths else None
+    )
+    predicted_s = predict_transfer_s(schedule, bandwidths, n_devices)
+    summary = schedule.summary()
+    if args.json:
+        print(json.dumps({
+            "checkpoint": args.from_checkpoint,
+            "src": {
+                "n_devices": manifest.get("n_devices"),
+                "layout": src_layout,
+            },
+            "dst": {"n_devices": n_devices, "layout": dst_layout},
+            "predicted_transfer_s": predicted_s,
+            "summary": summary,
+            "transfers": [t.to_row() for t in schedule.transfers],
+        }, indent=2))
+        return 0
+    src_desc = describe_topology(
+        int(manifest.get("n_devices", manifest.get("world_size", 0))), src_layout
+    )
+    print(f"Migration schedule for {args.from_checkpoint}:")
+    print(f"  from: {src_desc}")
+    print(f"  to:   {describe_topology(n_devices, dst_layout)} "
+          f"({layout_str(dst_layout)})")
+    print(schedule.format_table())
+    gib = summary["bytes_transferred"] / (1 << 30)
+    print(
+        f"  {summary['moved_leaves']}/{summary['leaves']} leaves move "
+        f"({gib:.3f} GiB on the wire), {summary['depth']} staging batch(es) "
+        f"under a {args.staging_budget_mb:g} MiB budget, "
+        f"{summary['host_staged']} host-staged."
+    )
+    print(f"  predicted transfer time: {predicted_s * 1e3:.1f} ms")
+    print("  (planned only — nothing was executed)")
+    return 0
+
+
 def plan_command(args: argparse.Namespace) -> int:
+    if args.from_checkpoint:
+        return _from_checkpoint_command(args)
+    if not args.model_name:
+        print(
+            "plan needs a builtin model spec (e.g. 'llama:7b') to search "
+            "layouts, or --from-checkpoint <dir> to print a migration "
+            "schedule.",
+            file=sys.stderr,
+        )
+        return 2
     from ..planner import (
         ALL_SEARCH_AXES,
         BandwidthTable,
@@ -146,8 +236,27 @@ def add_parser(subparsers) -> argparse.ArgumentParser:
     )
     p.add_argument(
         "model_name",
+        nargs="?",
+        default=None,
         help="Builtin model spec: 'llama:7b', 'llama:1b', 'llama:tiny', "
-             "'mixtral:tiny', 'opt:6b7', ...",
+             "'mixtral:tiny', 'opt:6b7', ... (optional with --from-checkpoint)",
+    )
+    p.add_argument(
+        "--from-checkpoint", dest="from_checkpoint", default=None,
+        help="Checkpoint dir with a plan_manifest.json: print the migration "
+             "schedule an elastic restore onto --devices/--to-layout would "
+             "run (leaves, bytes, predicted transfer time) without executing",
+    )
+    p.add_argument(
+        "--to-layout", dest="to_layout", default=None,
+        help="Destination layout for --from-checkpoint, e.g. "
+             "'dp_shard=2,tp=4' (default: keep model axes, rescale data "
+             "parallelism to --devices)",
+    )
+    p.add_argument(
+        "--staging-budget-mb", dest="staging_budget_mb", type=float,
+        default=256.0,
+        help="Staging HBM budget for --from-checkpoint batching (MiB)",
     )
     p.add_argument("--devices", type=int, default=None,
                    help="Device count to plan for (default: visible devices)")

@@ -675,6 +675,23 @@ class CompileManager:
             self._warmup_entry(entry)
         return dict(self.warmup_stats)
 
+    def invalidate_steps(self) -> int:
+        """Forget every warmed signature (elastic plan migration: the old
+        executables were specialized to the previous mesh/shardings). The
+        steps stay registered — jit retraces them for the new layout on the
+        next call, and ``warmup()`` re-warms every manifest signature.
+        Returns the number of executables dropped from the jit caches."""
+        dropped = 0
+        for entry in self._steps:
+            fn = entry["fn"]
+            try:
+                dropped += int(fn._cache_size())
+                fn.clear_cache()
+            except Exception:
+                pass
+            entry["warmed"] = set()
+        return dropped
+
     def _batch_sharding(self, ndim: int):
         from .parallel.sharding import batch_partition_spec
 

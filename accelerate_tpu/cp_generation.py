@@ -55,6 +55,97 @@ def _dp_axes(mesh) -> tuple:
     )
 
 
+def _manual_cp(mesh) -> bool:
+    """True when the ``cp`` axis is real and cross-shard ops must be issued
+    as manual shard_map collectives. GSPMD's auto-partitioned gather/slice/
+    reduce over a cp-sharded dim miscompiles on the CPU SPMD backend when the
+    mesh has other nontrivial axes (partial results are re-summed over
+    uninvolved axes, scaling values by that axis size), so everything that
+    communicates over cp goes through an explicit shard_map body instead."""
+    return mesh.shape.get("cp", 1) > 1
+
+
+def _embed_sharded(cfg, embed, input_ids, mesh, batch_axes):
+    """Embedding lookup with ids sequence-sharded over ``cp``: the table is
+    replicated, each chip gathers its own id chunk locally."""
+    from .utils.environment import shard_map_compat
+
+    b_ax = batch_axes if batch_axes else None
+    return shard_map_compat(
+        lambda tbl, idc: _embed_tokens(cfg, tbl, idc),
+        mesh=mesh,
+        in_specs=(P(None, None), P(b_ax, "cp")),
+        out_specs=P(b_ax, "cp", None),
+        check_vma=False,
+    )(embed, input_ids)
+
+
+def _gather_seq(ids, mesh, batch_axes):
+    """(B, S) cp-sharded -> replicated, via a manual tiled all_gather (the
+    output concat would otherwise auto-reshard over cp)."""
+    from .utils.environment import shard_map_compat
+
+    b_ax = batch_axes if batch_axes else None
+
+    def body(i_c):
+        return jax.lax.all_gather(i_c, "cp", axis=1, tiled=True)
+
+    return shard_map_compat(
+        body, mesh=mesh, in_specs=(P(b_ax, "cp"),),
+        out_specs=P(b_ax, None), check_vma=False,
+    )(ids)
+
+
+def _last_position(x, mesh, batch_axes):
+    """(B, S, E) with S cp-sharded -> (B, E) at the last global position,
+    replicated. The final chunk lives on the last cp shard; a tiny all_gather
+    of each shard's local last row keeps the extraction manual."""
+    from .utils.environment import shard_map_compat
+
+    b_ax = batch_axes if batch_axes else None
+
+    def body(x_c):
+        return jax.lax.all_gather(x_c[:, -1], "cp")[-1]
+
+    return shard_map_compat(
+        body, mesh=mesh, in_specs=(P(b_ax, "cp", None),),
+        out_specs=P(b_ax, None), check_vma=False,
+    )(x)
+
+
+def _prefix_stats_sharded(q, pk, pv, mesh, batch_axes):
+    """Flash-decoding partials against the cp-sharded prefix: local stats per
+    shard, then the exact online-softmax merge over cp as manual pmax/psum
+    (disjoint keysets, same combination as :func:`_merge_stats`)."""
+    from .utils.environment import shard_map_compat
+
+    b_ax = batch_axes if batch_axes else None
+
+    def body(q_c, k_c, v_c):
+        acc, m, l = attention_stats(q_c, k_c, v_c, causal=False)
+        m_g = jax.lax.pmax(m, "cp")
+        w = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * w, "cp")
+        acc_g = jax.lax.psum(acc * w[..., None], "cp")
+        return acc_g, m_g, l_g
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(b_ax, None, None, None),
+            P(b_ax, "cp", None, None),
+            P(b_ax, "cp", None, None),
+        ),
+        out_specs=(
+            P(b_ax, None, None, None),
+            P(b_ax, None, None),
+            P(b_ax, None, None),
+        ),
+        check_vma=False,
+    )(q, pk, pv)
+
+
 def _merge_stats(parts):
     """Exact combination of disjoint-keyset online-softmax partials."""
     m = parts[0][1]
@@ -83,7 +174,10 @@ def _prefill(cfg, params, input_ids, mesh, batch_axes=()):
 
     stacked, embed, final_norm, head = _unpack(cfg, params)
     b, s = input_ids.shape
-    x = _embed_tokens(cfg, embed, input_ids)
+    if _manual_cp(mesh):
+        x = _embed_sharded(cfg, embed, input_ids, mesh, batch_axes)
+    else:
+        x = _embed_tokens(cfg, embed, input_ids)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta, x.dtype)
     eps = cfg.rms_norm_eps
@@ -99,13 +193,17 @@ def _prefill(cfg, params, input_ids, mesh, batch_axes=()):
 
     x, (pk, pv) = jax.lax.scan(one_layer, x, stacked)
     x = rms_norm(x, _norm_w(cfg, final_norm, x), eps)
-    logits = x[:, -1] @ head.astype(cfg.dtype)
+    if _manual_cp(mesh):
+        last = _last_position(x, mesh, batch_axes)
+    else:
+        last = x[:, -1]
+    logits = last @ head.astype(cfg.dtype)
     return logits.astype(jnp.float32), pk, pv
 
 
 def _decode_loop(cfg, params, first_token, prefix_k, prefix_v, max_new_tokens,
                  *, rng, temperature, top_k, top_p, eos_token_id, pad_token_id,
-                 prompt_len, finished0=None):
+                 prompt_len, finished0=None, mesh=None, batch_axes=()):
     """lax.scan over decode steps. Tail caches are replicated (N is small);
     the prefix stays sequence-sharded — attention merges per-chip partials."""
     stacked, embed, final_norm, head = _unpack(cfg, params)
@@ -130,10 +228,13 @@ def _decode_loop(cfg, params, first_token, prefix_k, prefix_v, max_new_tokens,
             q, k_new, v_new = _qkv_proj(p["self_attn"], hn, cos, sin)
             tk = jax.lax.dynamic_update_slice(tk, k_new.astype(tk.dtype), (0, t, 0, 0))
             tv = jax.lax.dynamic_update_slice(tv, v_new.astype(tv.dtype), (0, t, 0, 0))
-            # Flash-decoding: partials against the LOCAL prefix shard (the
-            # max/sum/value contractions over the sharded seq dim lower to
-            # psums over cp), plus partials against the replicated tail.
-            stats_prefix = attention_stats(q, pk, pv, causal=False)
+            # Flash-decoding: partials against the LOCAL prefix shard, merged
+            # over cp with manual pmax/psum collectives, plus partials
+            # against the replicated tail.
+            if mesh is not None and _manual_cp(mesh):
+                stats_prefix = _prefix_stats_sharded(q, pk, pv, mesh, batch_axes)
+            else:
+                stats_prefix = attention_stats(q, pk, pv, causal=False)
             stats_tail = attention_stats(q, tk, tv, causal=False, kv_valid_len=t + 1)
             out = _merge_stats([stats_prefix, stats_tail])
             h = h + _out_proj(out.astype(h.dtype), p["self_attn"]["o_proj"]["kernel"])
@@ -252,9 +353,10 @@ def cp_generate(
                 rng=rng_key, temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_token_id=eos_token_id, pad_token_id=pad_token_id,
                 prompt_len=s,  # `first` sits at position s; step t decodes s+t
-                finished0=finished0,
+                finished0=finished0, mesh=mesh, batch_axes=dp,
             ) if max_new_tokens > 1 else jnp.zeros((b, 0), jnp.int32)
-            out = jnp.concatenate([ids, first[:, None], rest], axis=1)
+            ids_full = _gather_seq(ids, mesh, dp) if _manual_cp(mesh) else ids
+            out = jnp.concatenate([ids_full, first[:, None], rest], axis=1)
             return out
 
         fn = _CP_LOOP_CACHE[key] = jax.jit(run)

@@ -381,6 +381,43 @@ class FaultToleranceManager:
             "to restore it anyway."
         )
 
+    def _note_topology(self, path: str) -> None:
+        """Log when the resolver's pick was written under a different topology
+        than the live run, so an elastic resume is visible in the
+        fault-tolerance log before the restore path decides what to do."""
+        try:
+            from .resharding import (
+                describe_topology,
+                read_plan_manifest,
+                topology_matches,
+            )
+
+            manifest = read_plan_manifest(path)
+            if not manifest:
+                return
+            state = self.accelerator.state
+            n_devices = len(state.devices)
+            pc = getattr(state, "parallelism_config", None)
+            layout = pc.layout_dict() if pc is not None else None
+            if topology_matches(manifest, n_devices, layout):
+                return
+            logger.info(
+                "fault_tolerance: %s was saved on %s; this run is %s — the "
+                "restore path reshards it (or raises, if elastic restore is "
+                "off).",
+                path,
+                describe_topology(manifest.get("n_devices"), manifest.get("layout")),
+                describe_topology(n_devices, layout),
+            )
+            self._event(
+                "checkpoint_topology",
+                dir=path,
+                src_devices=manifest.get("n_devices"),
+                dst_devices=n_devices,
+            )
+        except Exception:  # pragma: no cover - advisory only
+            pass
+
     def resolve_verified(self, base: str, names_ascending: list[str]) -> str:
         """Newest name whose manifest verifies; torn ones are logged, counted
         and skipped. Legacy dirs without a manifest are accepted with a
@@ -400,6 +437,7 @@ class FaultToleranceManager:
             )
             if ok:
                 self._last_verified_dir = path
+                self._note_topology(path)
                 return name
             if reason == "no-manifest":
                 logger.warning_once(

@@ -192,7 +192,9 @@ def auto_flash_attention(q, k, v, *, causal: bool = True, mesh=None):
     q_spec = P(("dp_replicate", "dp_shard"), None, heads, None)
     kv_spec = P(("dp_replicate", "dp_shard"), None, heads, None)
     fn = functools.partial(flash_attention, causal=causal)
-    return jax.shard_map(
+    from ..utils.environment import shard_map_compat
+
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
         check_vma=False,
     )(q, k, v)

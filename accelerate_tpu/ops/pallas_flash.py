@@ -42,6 +42,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128
 
+# jax 0.4.x names the TPU compiler-params dataclass ``TPUCompilerParams``;
+# newer releases renamed it to ``CompilerParams``. Resolve whichever exists.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
@@ -165,7 +169,7 @@ def _fwd(q3, k3, v3, offs, *, causal, scale, block_q, block_k, sk_actual,
             jax.ShapeDtypeStruct((bh, sqp, dp), q3.dtype),
             jax.ShapeDtypeStruct((bh, sqp, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -307,7 +311,7 @@ def _bwd(q3, k3, v3, offs, out, lse, g_out, g_lse, *, causal, scale, block_q,
                           block_k=block_k, sk_actual=sk_actual),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sqp, dp), q3.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -348,7 +352,7 @@ def _bwd(q3, k3, v3, offs, out, lse, g_out, g_lse, *, causal, scale, block_q,
             jax.ShapeDtypeStruct((bkv, skp, dp), k3.dtype),
             jax.ShapeDtypeStruct((bkv, skp, dp), v3.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -128,7 +128,9 @@ def ring_attention(
             carry = one_step(step, carry)
         return carry[0].astype(q_c.dtype)
 
-    shard = jax.shard_map(
+    from ..utils.environment import shard_map_compat
+
+    shard = shard_map_compat(
         _local,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),

@@ -300,6 +300,20 @@ class ParallelismConfig:
     def get_device_mesh(self, devices=None):
         return self.build_mesh(devices)
 
+    def layout_dict(self) -> dict:
+        """Axis-degree dict in the planner's artifact schema (planner.py
+        plans, resharding.py plan manifests, and the ``plan`` CLI all speak
+        this form)."""
+        return {
+            "dp_replicate": self.dp_replicate_size,
+            "dp_shard": self.dp_shard_size,
+            "cp": self.cp_size,
+            "sp": self.sp_size,
+            "tp": self.tp_size,
+            "pp": self.pp_size,
+            "ep": self.ep_size,
+        }
+
     def __repr__(self) -> str:  # compact, hides size-1 axes
         active = {ax: self.axis_size(ax) for ax in MESH_AXIS_ORDER if self.axis_size(ax) > 1}
         if self.ep_size > 1:

@@ -537,15 +537,7 @@ def predict_step_time(
 
 
 def _layout_dict(pc: ParallelismConfig) -> dict:
-    return {
-        "dp_replicate": pc.dp_replicate_size,
-        "dp_shard": pc.dp_shard_size,
-        "cp": pc.cp_size,
-        "sp": pc.sp_size,
-        "tp": pc.tp_size,
-        "pp": pc.pp_size,
-        "ep": pc.ep_size,
-    }
+    return pc.layout_dict()
 
 
 def parallelism_config_from_layout(layout: dict) -> ParallelismConfig:
@@ -563,6 +555,41 @@ def parallelism_config_from_layout(layout: dict) -> ParallelismConfig:
 def layout_str(layout: dict) -> str:
     active = {k: v for k, v in layout.items() if v > 1}
     return ",".join(f"{k}={v}" for k, v in active.items()) or "single-device"
+
+
+def resize_pins(layout: dict, n_devices: int) -> dict:
+    """Pins for a planner re-search after an elastic resize (resharding.py).
+
+    The model-parallel axes (tp, cp, pp — sp is not plannable) are what the
+    previous run's search — and its calibration data — decided was winning
+    for this model; a device-count change shifts the *data*-parallel budget,
+    not the model's divisibility constraints. Keep each such axis pinned
+    while the running product still divides the new device count (greedy, in
+    the order the layout priced them); the dp axes are left free so the
+    search absorbs the resize there."""
+    pins: dict = {}
+    prod = 1
+    for ax in ("tp", "cp", "pp"):
+        n = int(layout.get(ax, 1))
+        if n > 1 and n_devices % (prod * n) == 0:
+            pins[ax] = n
+            prod *= n
+    return pins
+
+
+def scaled_layout(layout: dict, n_devices: int) -> Optional[dict]:
+    """The previous layout with only its data-parallel extent rescaled to
+    ``n_devices`` (the elastic ``resize_policy="keep"`` path). Returns None
+    when the non-dp axes no longer divide the new device count — callers
+    fall back to a pinned re-search."""
+    fixed = 1
+    for ax in ("tp", "cp", "sp", "pp", "dp_replicate"):
+        fixed *= int(layout.get(ax, 1))
+    if fixed > n_devices or n_devices % fixed != 0:
+        return None
+    out = {k: int(v) for k, v in layout.items()}
+    out["dp_shard"] = n_devices // fixed
+    return out
 
 
 @dataclasses.dataclass

@@ -164,6 +164,9 @@ class TelemetryRecorder:
         # record_serving and it rides the summary as the "serving" block.
         self._serving_summary: Optional[dict] = None
         self._serving_requests = 0
+        # Elastic reshard block (resharding.py): cumulative leaves/bytes/
+        # depth/wall time across restores and live migrations this run.
+        self._reshard_summary: Optional[dict] = None
         # Auto-parallelism plan (planner.py): note_plan installs the active
         # plan; after _plan_calibrate_after steps the measured step time +
         # peak HBM are written back into the plan artifact (the calibration
@@ -472,6 +475,28 @@ class TelemetryRecorder:
             "path": path,
         })
 
+    def record_reshard(self, block: dict) -> None:
+        """Record a completed elastic reshard (resharding.py): leaves moved,
+        bytes transferred, schedule depth, wall time, staging budget. The
+        summary gains a ``reshard`` block; repeated reshards (restore then a
+        live migration) accumulate the counters and keep the last kind."""
+        prev = self._reshard_summary or {}
+        merged = dict(block)
+        for k in ("leaves", "moved_leaves", "bytes", "bytes_transferred",
+                  "host_staged", "depth"):
+            merged[k] = int(prev.get(k, 0)) + int(block.get(k, 0) or 0)
+        merged["wall_s"] = round(
+            float(prev.get("wall_s", 0.0)) + float(block.get("wall_s", 0.0) or 0.0), 6
+        )
+        merged["peak_batch_bytes"] = max(
+            int(prev.get("peak_batch_bytes", 0)), int(block.get("peak_batch_bytes", 0) or 0)
+        )
+        merged["count"] = int(prev.get("count", 0)) + 1
+        self._reshard_summary = merged
+        self.record_event("reshard", **{
+            k: v for k, v in block.items() if not isinstance(v, dict) or k == "ops"
+        })
+
     def _plan_measurements(self) -> tuple[Optional[float], Optional[float]]:
         """(measured p50 step seconds, measured peak HBM GiB) so far."""
         step_s = None
@@ -606,6 +631,10 @@ class TelemetryRecorder:
             # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py):
             # bench rows embed it like the checkpoint/compile blocks.
             out["serving"] = dict(self._serving_summary)
+        if self._reshard_summary is not None:
+            # Elastic reshard block (resharding.py): leaves moved, bytes
+            # transferred, schedule depth, wall time, staging budget.
+            out["reshard"] = dict(self._reshard_summary)
         plan_block = self.plan_block()
         if plan_block is not None:
             # Auto-parallelism plan block (planner.py): predicted vs

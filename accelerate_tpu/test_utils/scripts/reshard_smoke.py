@@ -1,0 +1,299 @@
+"""`make reshard-smoke`: elastic resume across SLICE SIZES on the CPU mesh.
+
+Acceptance shape of the elastic-resharding subsystem end to end:
+
+1. A reference worker trains ``TOTAL_STEPS`` uninterrupted on a 4-way mesh
+   and records its final loss.
+2. A second 4-way worker is SIGTERM'd mid-epoch; it takes a preemption save
+   and exits with ``PREEMPTION_EXIT_CODE`` — the resumable contract of the
+   launch gang loop. Its checkpoint carries the plan manifest sidecar.
+3. The checkpoint is resumed TWICE with ``ACCELERATE_RESTART_ATTEMPT=1`` on
+   topologies the save never saw — a 2-way mesh (shrink) and an 8-way mesh
+   (grow). Each resume must restore through the planned collective schedule
+   (no leaf host-staged: they all fit the staging budget), report the
+   telemetry ``reshard`` block, and finish with the SAME final loss as the
+   uninterrupted 4-way reference.
+
+Each worker is this same file with ``--worker``; the driver pins the child's
+device count via ``--xla_force_host_platform_device_count``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+TOTAL_STEPS = 5
+PREEMPT_AFTER_STEP = 2
+BASE_DEVICES = 4
+RESUME_DEVICES = (2, 8)
+
+
+def worker(project_dir: str, status_file: str, total_steps: int) -> int:
+    import jax
+    import optax
+    import flax.linen as nn
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.utils import (
+        ElasticKwargs,
+        FaultToleranceKwargs,
+        FullyShardedDataParallelPlugin,
+        ProjectConfiguration,
+        TelemetryKwargs,
+        set_seed,
+    )
+
+    set_seed(0)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(32)(x)
+            x = nn.relu(x)
+            return nn.Dense(1)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32)
+
+    class Dataset:
+        def __len__(self):
+            return len(x)
+
+        def __getitem__(self, i):
+            return {"x": x[i], "y": y[i]}
+
+    class Spec:
+        dataset = Dataset()
+        batch_size = 16
+        sampler = None
+        drop_last = False
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir,
+            automatic_checkpoint_naming=True,
+            automatic_resume=True,
+        ),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=0),
+        kwargs_handlers=[
+            FaultToleranceKwargs(sentinel="off"),
+            ElasticKwargs(),
+            TelemetryKwargs(),
+        ],
+    )
+    module = Net()
+    model = Model.from_flax(module, jax.random.key(0), x[:1])
+    model, _, dl = acc.prepare(model, optax.adam(1e-2), Spec())
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        pred = module.apply({"params": params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    start_step = int(np.asarray(state.step))
+    n_devices = len(jax.devices())
+    reshard = acc.elastic.last_stats if acc.elastic is not None else None
+    telemetry_reshard = None
+    tel = getattr(acc, "telemetry", None)
+    if tel is not None:
+        telemetry_reshard = tel.summary().get("reshard")
+    print(f"RESHARD_START {start_step} devices={n_devices}", flush=True)
+
+    def write_status(**fields):
+        with open(status_file, "w") as f:
+            json.dump(
+                {
+                    "start_step": start_step,
+                    "n_devices": n_devices,
+                    "reshard": reshard,
+                    "telemetry_reshard": telemetry_reshard,
+                    **fields,
+                },
+                f,
+            )
+
+    last_loss = None
+    done = start_step
+    while done < total_steps:
+        for batch in dl:
+            state, metrics = step(state, batch)
+            last_loss = float(np.asarray(metrics["loss"]))
+            done = int(np.asarray(state.step))
+            print(f"RESHARD_STEP {done}", flush=True)
+            if acc.should_checkpoint():
+                acc.save_state()
+                write_status(preempted=True, saved_step=done, loss=last_loss)
+                acc.end_training()
+                print(f"RESHARD_PREEMPTED {done}", flush=True)
+                return acc.preemption_exit_code
+            if done >= total_steps:
+                break
+    write_status(preempted=False, final_step=done, final_loss=last_loss)
+    acc.end_training()
+    print(f"RESHARD_DONE {done} {last_loss}", flush=True)
+    return 0
+
+
+def _launch_worker(project_dir: str, status_file: str, n_devices: int, extra_env=None):
+    env = {**os.environ, **(extra_env or {})}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"), repo_root, os.getcwd()) if p
+    )
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         f"--project-dir={project_dir}", f"--status-file={status_file}",
+         f"--total-steps={TOTAL_STEPS}"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, bufsize=1,
+        env=env,
+    )
+
+
+def _drain(proc, timeout_s: float = 300.0) -> str:
+    out = []
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line:
+            out.append(line)
+            sys.stderr.write(line)
+    if proc.poll() is None:
+        proc.kill()
+        raise AssertionError("worker hung past the smoke timeout")
+    out.append(proc.stdout.read() or "")
+    sys.stderr.write(out[-1])
+    return "".join(out)
+
+
+def main() -> int:
+    import tempfile
+
+    from accelerate_tpu.utils.constants import PLAN_MANIFEST_NAME, PREEMPTION_EXIT_CODE
+
+    tmp = tempfile.mkdtemp(prefix="reshard_smoke_")
+    ref_dir = os.path.join(tmp, "reference")
+    run_dir = os.path.join(tmp, "preempted")
+    ref_status = os.path.join(tmp, "ref_status.json")
+    run_status = os.path.join(tmp, "run_status.json")
+
+    # --- 1. uninterrupted 4-way reference ------------------------------
+    proc = _launch_worker(ref_dir, ref_status, BASE_DEVICES)
+    _drain(proc)
+    assert proc.returncode == 0, f"reference run failed rc={proc.returncode}"
+    with open(ref_status) as f:
+        ref = json.load(f)
+    assert ref["final_step"] == TOTAL_STEPS, ref
+    assert ref["n_devices"] == BASE_DEVICES, ref
+
+    # --- 2. SIGTERM the 4-way worker mid-epoch -------------------------
+    proc = _launch_worker(run_dir, run_status, BASE_DEVICES)
+    deadline = time.monotonic() + 300
+    signaled = False
+    while proc.poll() is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            continue
+        sys.stderr.write(line)
+        if not signaled and line.startswith("RESHARD_STEP"):
+            if int(line.split()[1]) >= PREEMPT_AFTER_STEP:
+                proc.send_signal(signal.SIGTERM)
+                signaled = True
+    if proc.poll() is None:
+        proc.kill()
+        raise AssertionError("preempted worker hung")
+    sys.stderr.write(proc.stdout.read() or "")
+    assert signaled, "worker finished before the smoke could SIGTERM it"
+    assert proc.returncode == PREEMPTION_EXIT_CODE, (
+        f"expected PREEMPTION_EXIT_CODE ({PREEMPTION_EXIT_CODE}), got "
+        f"{proc.returncode}"
+    )
+    with open(run_status) as f:
+        preempt = json.load(f)
+    saved_step = preempt["saved_step"]
+    ckpt_base = os.path.join(run_dir, "checkpoints")
+    ckpts = [f for f in os.listdir(ckpt_base)
+             if f.startswith("checkpoint_") and not f.endswith(".tmp")]
+    assert ckpts, os.listdir(ckpt_base)
+    # The save carries the topology sidecar the resumes will plan from.
+    assert any(
+        os.path.isfile(os.path.join(ckpt_base, c, PLAN_MANIFEST_NAME)) for c in ckpts
+    ), f"no {PLAN_MANIFEST_NAME} in {ckpts}"
+
+    # --- 3. resume the SAME checkpoint on 2-way and 8-way meshes -------
+    for n in RESUME_DEVICES:
+        resume_dir = os.path.join(tmp, f"resume_{n}")
+        shutil.copytree(run_dir, resume_dir)
+        status = os.path.join(tmp, f"resume_{n}_status.json")
+        proc = _launch_worker(
+            resume_dir, status, n, extra_env={"ACCELERATE_RESTART_ATTEMPT": "1"}
+        )
+        _drain(proc)
+        assert proc.returncode == 0, f"{n}-way resume failed rc={proc.returncode}"
+        with open(status) as f:
+            resumed = json.load(f)
+        assert resumed["n_devices"] == n, resumed
+        assert resumed["start_step"] == saved_step, (
+            f"{n}-way resume started at step {resumed['start_step']}, but the "
+            f"preemption save was at step {saved_step}"
+        )
+        assert resumed["final_step"] == TOTAL_STEPS, resumed
+        reshard = resumed.get("reshard")
+        assert reshard, f"{n}-way resume restored without a reshard: {resumed}"
+        assert reshard["moved_leaves"] > 0, reshard
+        assert reshard["host_staged"] == 0, (
+            f"leaves that fit the staging budget must redistribute on-device, "
+            f"not gather to host: {reshard}"
+        )
+        assert reshard["peak_batch_bytes"] <= reshard["staging_budget_bytes"], reshard
+        assert resumed.get("telemetry_reshard"), (
+            f"telemetry summary has no reshard block: {resumed}"
+        )
+        np.testing.assert_allclose(
+            resumed["final_loss"], ref["final_loss"], rtol=1e-6,
+            err_msg=(
+                f"{n}-way resumed run's final loss diverged from the "
+                f"uninterrupted {BASE_DEVICES}-way run"
+            ),
+        )
+        print(
+            f"RESHARD RESUME OK on {n} devices — {reshard['moved_leaves']} "
+            f"leaves via {reshard['ops']}, {reshard['bytes_transferred']:,} "
+            f"bytes in {reshard['depth']} batch(es), final loss "
+            f"{resumed['final_loss']:.6f}",
+            flush=True,
+        )
+
+    print(
+        f"RESHARD SMOKE OK — preempted a {BASE_DEVICES}-way run at step "
+        f"{saved_step}/{TOTAL_STEPS}, resumed on "
+        f"{' and '.join(str(n) for n in RESUME_DEVICES)} devices with "
+        f"loss == reference"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--project-dir", default=None)
+    parser.add_argument("--status-file", default=None)
+    parser.add_argument("--total-steps", type=int, default=TOTAL_STEPS)
+    args = parser.parse_args()
+    if args.worker:
+        sys.exit(worker(args.project_dir, args.status_file, args.total_steps))
+    sys.exit(main())

@@ -54,6 +54,11 @@ SCALER_NAME = "scaler"
 CHECKPOINT_DIR_REGEX = r"^checkpoint_(\d+)$"
 CHECKPOINT_STAGING_SUFFIX = ".tmp"
 CHECKPOINT_MANIFEST_NAME = "manifest.json"
+# Elastic resharding (resharding.py): a sidecar written next to the model
+# files recording the SOURCE topology — mesh layout + per-leaf sharding
+# specs — so a restore onto a different mesh can plan a redistribution
+# schedule instead of failing on the shape/world-size mismatch.
+PLAN_MANIFEST_NAME = "plan_manifest.json"
 # Exit code a preemption-triggered save exits with (BSD EX_TEMPFAIL): the
 # launch gang loop treats it as "resumable — relaunch with
 # ACCELERATE_RESTART_ATTEMPT+1" instead of a crash.

@@ -364,6 +364,51 @@ class FaultToleranceKwargs(KwargsHandler):
 
 
 @dataclass
+class ElasticKwargs(KwargsHandler):
+    """Elastic-resharding config (resharding.py). Passing this handler to
+    ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it
+    ``accelerator.elastic`` is ``None``, every hook site is a single ``None``
+    check, and a topology-mismatched restore raises
+    :class:`~accelerate_tpu.resharding.TopologyMismatchError` instead of
+    resharding.
+
+    - **Elastic restore** (``elastic_restore``): a checkpoint written on N
+      devices restores on M≠N through a planned redistribution schedule —
+      each leaf ingested under its *source* sharding spec (projected onto
+      the new mesh) and redistributed on-device, batched so per-device bytes
+      in flight never exceed ``staging_budget_mb``. Leaves that cannot fit
+      even alone fall back to host-staged chunked ingest when
+      ``host_stage_oversize`` is on.
+    - **Live migration**: :meth:`Accelerator.migrate_plan` reshards the
+      prepared ``TrainState`` (donated buffers; RNG, dataloader cursor and
+      grad-accum state carried over) onto a new plan/layout mid-run and
+      invalidates + optionally re-warms (``warm_after_migrate``) the
+      compile-manager executables for the new shapes.
+    - **Resize policy** (``resize_policy``): what an elastic relaunch
+      (``ACCELERATE_RESTART_ATTEMPT`` > 0) does when it comes back on a
+      different device count. ``"replan"`` re-runs the planner search under
+      the new topology — pinning the model-parallel axes the calibration
+      data says are winning when ``pin_winning_axes`` is on; ``"keep"``
+      keeps the checkpoint's layout scaled to the new count; ``"fail"``
+      refuses (same error as elastic off).
+    """
+
+    enabled: bool = True
+    elastic_restore: bool = True
+    staging_budget_mb: float = 256.0
+    host_stage_oversize: bool = True
+    resize_policy: str = "replan"  # replan | keep | fail
+    pin_winning_axes: bool = True
+    warm_after_migrate: bool = True
+
+    def __post_init__(self):
+        if self.resize_policy not in ("replan", "keep", "fail"):
+            raise ValueError("resize_policy must be replan|keep|fail")
+        if self.staging_budget_mb <= 0:
+            raise ValueError("staging_budget_mb must be > 0")
+
+
+@dataclass
 class CompileKwargs(KwargsHandler):
     """Compile-manager config (compile_manager.py). Passing this handler to
     ``Accelerator(kwargs_handlers=[...])`` turns the subsystem on; without it

@@ -31,6 +31,110 @@ def honor_jax_platforms_env() -> None:
         pass
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None):
+    """``jax.shard_map`` across the API move.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=, axis_names=)``; older
+    releases only have ``jax.experimental.shard_map.shard_map(...,
+    check_rep=, auto=)``. ``axis_names`` (manual axes) would map onto legacy
+    ``auto=`` (its complement), but partial-manual lowers to a PartitionId op
+    the CPU backend rejects, so the legacy path runs full-manual instead.
+
+    Full-manual has a sharp edge: an out_spec that omits a size>1 mesh axis
+    leaves the output marked partial over that axis, and mixing such an
+    output with ordinary values in the same jit silently scales them by the
+    axis size (GSPMD repartitions the replicated operand as if it were
+    unreduced). The legacy path therefore injects one leading broadcast dim
+    per omitted size>1 axis into each out_spec — making the replication
+    explicit — and reduces the dims back off after the call."""
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+    from jax.sharding import PartitionSpec
+
+    check_rep = bool(check_vma)
+
+    def spec_axes(s):
+        axes = set()
+        for entry in tuple(s):
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.update(entry)
+            else:
+                axes.add(entry)
+        return axes
+
+    is_spec = lambda x: isinstance(x, PartitionSpec)
+    spec_leaves, spec_treedef = jax.tree_util.tree_flatten(out_specs, is_leaf=is_spec)
+    big = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    extras = [tuple(a for a in big if a not in spec_axes(s)) for s in spec_leaves]
+    if not any(extras):
+        return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_rep)
+
+    padded = jax.tree_util.tree_unflatten(
+        spec_treedef,
+        [PartitionSpec(*e, *tuple(s)) for s, e in zip(spec_leaves, extras)],
+    )
+
+    def _zip_outputs(out):
+        """Pair each output leaf with its spec's injected-dim count."""
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        if len(spec_leaves) == 1:
+            ks = [len(extras[0])] * len(leaves)
+        elif len(leaves) == len(spec_leaves):
+            ks = [len(e) for e in extras]
+        else:
+            raise ValueError(
+                "shard_map_compat: out_specs structure does not match outputs"
+            )
+        return leaves, treedef, ks
+
+    def wrapped(*args):
+        import jax.numpy as jnp
+
+        out = f(*args)
+        leaves, treedef, ks = _zip_outputs(out)
+        leaves = [
+            jnp.broadcast_to(o, (1,) * k + jnp.shape(o)) for o, k in zip(leaves, ks)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    mapped = legacy(wrapped, mesh=mesh, in_specs=in_specs, out_specs=padded,
+                    check_rep=check_rep)
+
+    def _strip(o, k):
+        """Remove the injected leading dims by *reduction*, not slicing: a
+        slice of a sharded dim can lower to mask+all-reduce, which is the
+        very partial-sum pathway being worked around. All slices along the
+        injected dims hold identical values, so mean (floats; its VJP splits
+        the cotangent, and the broadcast transpose re-sums it) or max (ints,
+        bools — no autodiff) recovers the value through a genuine reduction."""
+        import jax.numpy as jnp
+
+        if k == 0:
+            return o
+        axes = tuple(range(k))
+        if jnp.issubdtype(jnp.result_type(o), jnp.inexact):
+            return jnp.mean(o, axis=axes)
+        return jnp.max(o, axis=axes)
+
+    def call(*args):
+        out = mapped(*args)
+        leaves, treedef, ks = _zip_outputs(out)
+        leaves = [_strip(o, k) for o, k in zip(leaves, ks)]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return call
+
+
 def str_to_bool(value: str) -> int:
     """Convert a string to a bool int, accepting y/yes/t/true/on/1 (case-insensitive).
 

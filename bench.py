@@ -39,6 +39,7 @@ seq 8192 where dots residuals no longer fit.
 import argparse
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -480,7 +481,7 @@ def _parse_json_line(line: str):
     return None
 
 
-def _backend_probe(timeout_s: int = 90) -> tuple[bool, str]:
+def _backend_probe(timeout_s: int = 90, env: dict | None = None) -> tuple[bool, str]:
     """Cheap pre-flight: can a fresh process see a device at all?
 
     A dead axon relay makes ``jax.devices()`` hang forever, so without this
@@ -504,7 +505,8 @@ def _backend_probe(timeout_s: int = 90) -> tuple[bool, str]:
     )
     try:
         r = subprocess.run(
-            [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
+            [sys.executable, "-c", probe], capture_output=True, text=True,
+            timeout=timeout_s, env=env,
         )
         if r.returncode == 0 and "ok" in (r.stdout or ""):
             return True, ""
@@ -513,7 +515,7 @@ def _backend_probe(timeout_s: int = 90) -> tuple[bool, str]:
         return False, "timeout"
 
 
-def _run_child_streaming(cmd, timeout_s: float):
+def _run_child_streaming(cmd, timeout_s: float, env: dict | None = None):
     """Run the child, forwarding its JSON evidence lines to stdout THE MOMENT
     they appear (round-3 postmortem: ``subprocess.run(capture_output=True)``
     buffered everything, so the driver's kill left an empty tail).
@@ -521,7 +523,8 @@ def _run_child_streaming(cmd, timeout_s: float):
     Returns ``(returncode_or_None_on_timeout, best_row_or_None, stderr_tail)``.
     """
     proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, bufsize=1
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, bufsize=1,
+        env=env,
     )
     best = {"row": None}
     stderr_buf = []
@@ -566,6 +569,13 @@ def supervise() -> int:
     best_partial = None
     attempt = 0
     max_attempts = 6
+    # Per-backend probe cap: a relay that stays dead through PROBE_CAP probes
+    # is not coming back inside this budget — fall back to the CPU mesh
+    # ladder and keep producing evidence rows instead of an error row.
+    PROBE_CAP = 3
+    probe_fails = 0
+    fallback_env = None
+    fallback_reason = ""
     _emit(0.0, f"HEARTBEAT: supervisor up, deadline {DEADLINE_S}s", 0.0, event="start")
     while attempt < max_attempts:
         attempt += 1
@@ -573,7 +583,9 @@ def supervise() -> int:
         if remaining < 90:
             last_err = last_err or "supervisor wall-clock budget exhausted"
             break
-        alive, probe_err = _backend_probe(timeout_s=min(75, int(remaining / 2)))
+        alive, probe_err = _backend_probe(
+            timeout_s=min(75, int(remaining / 2)), env=fallback_env
+        )
         if not alive:
             if probe_err != "timeout" and not any(
                 pat in probe_err.lower() for pat in RETRYABLE
@@ -582,15 +594,35 @@ def supervise() -> int:
                 # cannot help — fail now with the real stderr.
                 last_err = f"backend probe failed deterministically:\n{probe_err}"
                 break
+            probe_fails += 1
+            last_err = f"attempt {attempt}: backend probe failed ({probe_err[:200]})"
+            attempt -= 1
+            if fallback_env is None and probe_fails >= PROBE_CAP:
+                # Dead relay: switch every later probe + child to the CPU
+                # mesh ladder. Slower numbers, but measured rows with the
+                # reason attached beat an error row after a burned budget.
+                fallback_reason = (
+                    f"device backend unreachable after {probe_fails} probes "
+                    f"({probe_err[:120]})"
+                )
+                fallback_env = {
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                }
+                _emit(0.0, "HEARTBEAT: falling back to the CPU mesh ladder",
+                      0.0, event="cpu_fallback", reason=fallback_reason)
+                continue
             # Hang or retryable error: relay down — wait it out (cheap)
             # rather than burn a child timeout. Probe failures don't consume
-            # child attempts; the wall-clock deadline bounds this.
-            last_err = f"attempt {attempt}: backend probe failed ({probe_err[:200]})"
+            # child attempts; the wall-clock deadline bounds this. The sleep
+            # is jittered so restarted gangs don't re-probe in lockstep.
             _emit(0.0, f"HEARTBEAT: relay down, waiting ({probe_err[:120]})", 0.0,
-                  event="probe_fail", attempt=attempt)
-            attempt -= 1
-            time.sleep(min(45, max(5, remaining - 90)))
+                  event="probe_fail", attempt=attempt, probe_fails=probe_fails)
+            base = min(45, max(5, remaining - 90))
+            time.sleep(base * (0.5 + random.random()))
             continue
+        probe_fails = 0
         _emit(0.0, f"HEARTBEAT: probe ok, launching child attempt {attempt}", 0.0,
               event="probe_ok", attempt=attempt, oom_level=oom_level)
         child_kill = max(60.0, (deadline - time.monotonic()) - 45)
@@ -601,10 +633,20 @@ def supervise() -> int:
         child_budget = max(45.0, child_kill - 30.0)
         cmd = [sys.executable, os.path.abspath(__file__), "--child",
                f"--oom-level={oom_level}", f"--budget-s={child_budget:.0f}"]
-        rc, row, err_tail = _run_child_streaming(cmd, timeout_s=child_kill)
+        rc, row, err_tail = _run_child_streaming(
+            cmd, timeout_s=child_kill, env=fallback_env
+        )
         if row is not None:
+            if fallback_env is not None:
+                row["fallback"] = "cpu-mesh-ladder"
+                row["fallback_reason"] = fallback_reason
             best_partial = row
         if rc == 0 and row is not None and row.get("event") == "final":
+            if fallback_env is not None:
+                # Re-emit the final row with the fallback provenance attached
+                # so the driver's last-line parse sees why the numbers are
+                # CPU-mesh numbers.
+                print(json.dumps(row), flush=True)
             return 0  # the final row is already on stdout
         if rc is None:
             last_err = f"attempt {attempt}: child hit supervisor deadline"

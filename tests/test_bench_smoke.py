@@ -37,3 +37,46 @@ def test_bench_child_cpu_smoke():
     assert tel["steps"] > 0
     assert tel["step_time_mean_s"] > 0
     assert "recompiles" in tel and "peak_hbm_bytes" in tel
+
+
+def test_supervisor_cpu_fallback_after_dead_probes(monkeypatch, capsys):
+    """A relay that stays dead through the probe cap must yield a measured
+    CPU-mesh-ladder row with the reason attached — not an error row."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_fallback_test", os.path.join(repo, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    calls = {"children": 0}
+
+    def fake_probe(timeout_s=90, env=None):
+        # The device backend hangs forever; the CPU fallback env answers.
+        if env is not None and env.get("JAX_PLATFORMS") == "cpu":
+            return True, ""
+        return False, "timeout"
+
+    def fake_child(cmd, timeout_s, env=None):
+        calls["children"] += 1
+        assert env is not None and env.get("JAX_PLATFORMS") == "cpu"
+        row = {"metric": bench.METRIC, "value": 12.5, "unit": "tok/s/chip",
+               "vs_baseline": 0.1, "event": "final"}
+        return 0, row, ""
+
+    monkeypatch.setattr(bench, "_backend_probe", fake_probe)
+    monkeypatch.setattr(bench, "_run_child_streaming", fake_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    rc = bench.supervise()
+    out = capsys.readouterr().out
+    assert rc == 0
+    rows = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    assert any(r.get("event") == "cpu_fallback" for r in rows)
+    final = rows[-1]
+    assert final["event"] == "final" and final["value"] == 12.5
+    assert final["fallback"] == "cpu-mesh-ladder"
+    assert "unreachable" in final["fallback_reason"]
+    assert calls["children"] == 1, "fallback must not burn extra child attempts"

@@ -1,0 +1,454 @@
+"""Elastic resharding (resharding.py): spec serialization, collective
+classification, budget-batched schedules, the plan-manifest sidecar, the
+topology-mismatch guard, safetensors restore across layouts (N->M->N
+bit-equal), host-staged fallback, and live plan migration."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def _reset_state():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+# ---------------------------------------------------------------------------
+# Spec serialization + op classification (pure host-side units)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_jsonable_roundtrip():
+    from jax.sharding import PartitionSpec
+
+    from accelerate_tpu.resharding import spec_from_jsonable, spec_to_jsonable
+
+    for spec in (
+        PartitionSpec(),
+        PartitionSpec("dp_shard"),
+        PartitionSpec("dp_shard", None, "tp"),
+        PartitionSpec(("dp_replicate", "dp_shard"), "tp"),
+        None,
+    ):
+        entries = spec_to_jsonable(spec)
+        # Survives a JSON round-trip (the manifest is JSON on disk).
+        entries = json.loads(json.dumps(entries))
+        back = spec_to_jsonable(spec_from_jsonable(entries))
+        assert back == entries
+    assert spec_to_jsonable(None) == []
+    assert tuple(spec_from_jsonable([])) == ()
+
+
+def test_normalize_spec_drops_dead_axes():
+    from accelerate_tpu.resharding import normalize_spec
+
+    sizes = {"dp_shard": 4, "tp": 1}
+    # A size-1 axis shards nothing; trailing unsharded dims are noise.
+    assert normalize_spec(["tp", "dp_shard", None], sizes) == ((), ("dp_shard",))
+    assert normalize_spec([None, None], sizes) == ()
+    assert normalize_spec([["dp_shard", "tp"]], sizes) == (("dp_shard",),)
+
+
+def test_classify_op_matrix():
+    from accelerate_tpu.resharding import classify_op
+
+    eight = {"dp_shard": 8, "tp": 1}
+    four = {"dp_shard": 4, "tp": 2}
+    two = {"dp_shard": 2, "tp": 1}
+
+    # Identical spec + degree: nothing to do.
+    assert classify_op(["dp_shard"], ["dp_shard"], eight, eight) == "noop"
+    # Replicated -> replicated (any device count): broadcast, not a re-tile.
+    assert classify_op([], [None], eight, two) == "noop"
+    # Replicated -> sharded: every device keeps a slice.
+    assert classify_op([], ["dp_shard"], eight, eight) == "slice"
+    # Sharded -> replicated: gather.
+    assert classify_op(["dp_shard"], [], eight, eight) == "all_gather"
+    # Same axis, different degree (shrink 8->2 and grow 2->8): re-tile.
+    assert classify_op(["dp_shard"], ["dp_shard"], eight, two) == "all_to_all"
+    assert classify_op(["dp_shard"], ["dp_shard"], two, eight) == "all_to_all"
+    # Axis permutation (dim0 tp -> dim1 tp): re-tile.
+    assert classify_op(["tp", None], [None, "tp"], four, four) == "all_to_all"
+    # dp_shard -> tp on the same dim: re-tile.
+    assert classify_op(["dp_shard"], ["tp"], eight, four) == "all_to_all"
+
+
+def test_plan_leaf_transfer_and_budget_batching():
+    from accelerate_tpu.resharding import build_schedule, plan_leaf_transfer
+
+    eight = {"dp_shard": 8}
+    two = {"dp_shard": 2}
+    # Odd leaf shape: bytes math must not assume divisibility.
+    t = plan_leaf_transfer("w", (17, 3), "float32", ["dp_shard"], ["dp_shard"], eight, two, 0)
+    assert t.nbytes == 17 * 3 * 4 and t.op == "all_to_all"
+    # Footprint while transferring = ingest copy + destination shard.
+    assert t.device_bytes == 2 * (t.nbytes // 2)
+
+    transfers = [
+        plan_leaf_transfer(f"leaf{i}", (64,), "float32", [], ["dp_shard"], eight, two, i)
+        for i in range(6)
+    ]
+    # 64 floats repl->2-way: 256B + 128B = 384B footprint each. A 800B budget
+    # fits two per batch; six leaves -> three batches.
+    sched = build_schedule(list(transfers), 800)
+    assert sched.depth == 3
+    assert sched.peak_batch_bytes <= 800
+    covered = sorted(i for b in sched.batches for i in b)
+    assert covered == list(range(6))
+    assert sched.summary()["ops"] == {"slice": 6}
+
+    # A leaf alone over budget is host-staged into its own batch, and its
+    # footprint drops to the destination shard alone.
+    big = plan_leaf_transfer("zz_big", (1024,), "float32", [], ["dp_shard"], eight, two, 6)
+    sched = build_schedule(list(transfers) + [big], 800)
+    staged = [t for t in sched.transfers if t.host_staged]
+    assert [t.name for t in staged] == ["zz_big"]
+    assert staged[0].device_bytes == staged[0].dst_bytes
+    assert [6] in sched.batches
+    # With host staging off, the oversize leaf stays a device transfer.
+    big2 = plan_leaf_transfer("zz_big", (1024,), "float32", [], ["dp_shard"], eight, two, 6)
+    sched = build_schedule(list(transfers) + [big2], 800, host_stage_oversize=False)
+    assert sched.host_staged_leaves == 0
+
+
+def test_schedule_from_manifest_and_predict():
+    from accelerate_tpu.planner import BandwidthTable
+    from accelerate_tpu.resharding import predict_transfer_s, schedule_from_manifest
+
+    manifest = {
+        "version": 1,
+        "n_devices": 8,
+        "layout": {"dp_shard": 8},
+        "leaves": {
+            "slot0/params/w": {"shape": [256, 64], "dtype": "float32", "spec": ["dp_shard"]},
+            "slot0/params/b": {"shape": [64], "dtype": "float32", "spec": []},
+            "slot0/opt_state/mu/w": {"shape": [256, 64], "dtype": "float32", "spec": ["dp_shard"]},
+        },
+    }
+    sched = schedule_from_manifest(manifest, {"dp_shard": 2}, 1 << 20)
+    s = sched.summary()
+    assert s["leaves"] == 3
+    # Sharded leaves re-tile 8->2; the replicated bias is untouched.
+    assert s["ops"]["all_to_all"] == 2 and s["ops"]["noop"] == 1
+    assert s["bytes_transferred"] == 2 * 256 * 64 * 4
+    t = predict_transfer_s(sched, BandwidthTable(), 2)
+    assert t > 0
+    table = sched.format_table()
+    assert "slot0/params/w" in table and "all_to_all" in table
+
+
+# ---------------------------------------------------------------------------
+# Integration: manifest sidecar + elastic restore on the safetensors path
+# ---------------------------------------------------------------------------
+
+
+def _setup(pc=None, handlers=None, width=32, seed=3):
+    """Small FSDP-sharded dense model; returns (acc, module, loss_fn, batch)."""
+    import jax
+    import optax
+    import flax.linen as nn
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+    _reset_state()
+    set_seed(seed)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(width)(x)
+            x = nn.relu(x)
+            return nn.Dense(1)(x)
+
+    acc = Accelerator(
+        parallelism_config=pc,
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=0),
+        kwargs_handlers=handlers,
+    )
+    module = Net()
+    x = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32)
+    model = Model.from_flax(module, jax.random.key(0), x[:1])
+    model, _ = acc.prepare(model, optax.adam(1e-2))
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        pred = module.apply({"params": params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return acc, module, loss_fn, {"x": x, "y": y}
+
+
+def _host_leaves(state):
+    import jax
+
+    return jax.tree.map(
+        lambda a: np.asarray(a) if hasattr(a, "shape") else a, state
+    )
+
+
+def _assert_trees_bit_equal(got, want):
+    # Compare leaf lists, not the trees: two TrainStates built by different
+    # Accelerator instances differ in static aux data (apply_fn, tx).
+    import jax
+
+    got_leaves = jax.tree_util.tree_leaves(got)
+    want_leaves = jax.tree_util.tree_leaves(want)
+    assert len(got_leaves) == len(want_leaves)
+    for a, b in zip(got_leaves, want_leaves):
+        if hasattr(b, "shape") or hasattr(a, "shape"):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _elastic(**kw):
+    from accelerate_tpu.utils import ElasticKwargs
+
+    return ElasticKwargs(**kw)
+
+
+def test_plan_manifest_sidecar_written_and_matches(tmp_path):
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.resharding import read_plan_manifest, topology_matches
+    from accelerate_tpu.utils.constants import PLAN_MANIFEST_NAME
+
+    acc, module, loss_fn, batch = _setup(
+        ParallelismConfig(dp_shard_size=8), handlers=[_elastic()]
+    )
+    step = acc.prepare_train_step(loss_fn)
+    acc._train_state, _ = step(acc.train_state, batch)
+    out = acc.save_state(str(tmp_path / "ck"))
+    assert os.path.isfile(os.path.join(out, PLAN_MANIFEST_NAME))
+
+    manifest = read_plan_manifest(out)
+    assert manifest is not None
+    assert manifest["n_devices"] == 8
+    assert manifest["layout"]["dp_shard"] == 8
+    # Per-leaf specs recorded under slot-qualified names.
+    names = list(manifest["leaves"])
+    assert any(n.startswith("slot0/params/") for n in names)
+    assert any(n.startswith("slot0/opt_state/") for n in names)
+    kernel = manifest["leaves"]["slot0/params/Dense_0/kernel"]
+    assert kernel["spec"], "fsdp-sharded kernel must record a non-empty spec"
+
+    live = acc.state.parallelism_config.layout_dict()
+    assert topology_matches(manifest, 8, live)
+    assert not topology_matches(manifest, 4, live)
+    assert not topology_matches(manifest, 8, dict(live, dp_shard=2, tp=4))
+
+    # Without fault tolerance or elastic, the byte layout is unchanged: no
+    # sidecar appears.
+    acc2, module2, loss_fn2, batch2 = _setup(ParallelismConfig(dp_shard_size=8))
+    out2 = acc2.save_state(str(tmp_path / "ck_plain"))
+    assert not os.path.exists(os.path.join(out2, PLAN_MANIFEST_NAME))
+
+
+@pytest.mark.parametrize("direction", ["shrink", "grow"])
+def test_topology_mismatch_raises_without_elastic(tmp_path, direction):
+    """Mismatch with elastic OFF fails fast, naming both topologies and the
+    opt-in — in both directions (N->M and M->N)."""
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.resharding import TopologyMismatchError
+
+    wide = ParallelismConfig(dp_shard_size=8)
+    narrow = ParallelismConfig(dp_replicate_size=4, dp_shard_size=2)
+    src_pc, dst_pc = (wide, narrow) if direction == "shrink" else (narrow, wide)
+
+    acc, module, loss_fn, batch = _setup(src_pc, handlers=[_elastic()])
+    out = acc.save_state(str(tmp_path / "ck"))
+
+    acc2, module2, loss_fn2, batch2 = _setup(dst_pc)  # no ElasticKwargs
+    with pytest.raises(TopologyMismatchError) as ei:
+        acc2.load_state(out)
+    msg = str(ei.value)
+    assert "dp_shard=8" in msg
+    assert "dp_replicate=4" in msg and "dp_shard=2" in msg
+    assert "ElasticKwargs" in msg
+
+
+def test_resize_policy_fail_raises_even_with_elastic(tmp_path):
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.resharding import TopologyMismatchError
+
+    acc, *_ = _setup(ParallelismConfig(dp_shard_size=8), handlers=[_elastic()])
+    out = acc.save_state(str(tmp_path / "ck"))
+    acc2, *_ = _setup(
+        ParallelismConfig(dp_replicate_size=2, dp_shard_size=4),
+        handlers=[_elastic(resize_policy="fail")],
+    )
+    with pytest.raises(TopologyMismatchError):
+        acc2.load_state(out)
+
+
+def test_safetensors_reshard_roundtrip_bit_equal(tmp_path):
+    """N->M->N: save under dp_shard=8, restore under dp_replicate=2 x
+    dp_shard=4 (elastic), save again, restore under dp_shard=8 — every
+    TrainState leaf bit-equal throughout, nothing host-staged (every shard
+    fits the budget), telemetry carries the reshard block."""
+    import jax
+
+    from accelerate_tpu import ParallelismConfig
+
+    wide = lambda: ParallelismConfig(dp_shard_size=8)
+    narrow = lambda: ParallelismConfig(dp_replicate_size=2, dp_shard_size=4)
+
+    acc, module, loss_fn, batch = _setup(wide(), handlers=[_elastic()])
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    for _ in range(2):
+        state, _ = step(state, batch)
+    acc._train_state = state
+    want = _host_leaves(state)
+    ck1 = acc.save_state(str(tmp_path / "ck1"))
+
+    # N -> M
+    acc2, module2, loss_fn2, batch2 = _setup(narrow(), handlers=[_elastic()])
+    acc2.load_state(ck1)
+    _assert_trees_bit_equal(acc2.train_state, want)
+    # The restore went through the reshard path and reported it.
+    stats = acc2.elastic.last_stats
+    assert stats is not None and stats["kind"] == "restore"
+    assert stats["moved_leaves"] > 0
+    assert stats["host_staged"] == 0, "fitting leaves must not gather to host"
+    assert stats["peak_batch_bytes"] <= acc2.elastic.staging_budget_bytes
+    tel = getattr(acc2, "telemetry", None)
+    if tel is not None:
+        assert "reshard" in tel.summary()
+    # Restored leaves landed on the NARROW plan's shardings (dp_shard=4).
+    kernel = acc2.train_state.params["Dense_0"]["kernel"]
+    from accelerate_tpu.resharding import mesh_axis_sizes
+
+    assert mesh_axis_sizes(kernel.sharding.mesh)["dp_shard"] == 4
+    ck2 = acc2.save_state(str(tmp_path / "ck2"))
+
+    # M -> N
+    acc3, module3, loss_fn3, batch3 = _setup(wide(), handlers=[_elastic()])
+    acc3.load_state(ck2)
+    _assert_trees_bit_equal(acc3.train_state, want)
+    # Training continues from the restored state.
+    step3 = acc3.prepare_train_step(loss_fn3)
+    state3, m3 = step3(acc3.train_state, batch3)
+    assert np.isfinite(float(np.asarray(m3["loss"])))
+
+
+def test_tiny_budget_forces_host_staging_still_exact(tmp_path):
+    """A staging budget smaller than any shard demotes every moving leaf to
+    the host-staged path — slower, but still bit-exact."""
+    from accelerate_tpu import ParallelismConfig
+
+    acc, module, loss_fn, batch = _setup(
+        ParallelismConfig(dp_shard_size=8), handlers=[_elastic()], width=256
+    )
+    want = _host_leaves(acc.train_state)
+    out = acc.save_state(str(tmp_path / "ck"))
+
+    acc2, *_ = _setup(
+        ParallelismConfig(dp_replicate_size=2, dp_shard_size=4),
+        handlers=[_elastic(staging_budget_mb=0.001)],
+        width=256,
+    )
+    acc2.load_state(out)
+    _assert_trees_bit_equal(acc2.train_state, want)
+    stats = acc2.elastic.last_stats
+    assert stats["host_staged"] > 0
+
+
+def test_tp_axis_change_reshard_bit_equal(tmp_path):
+    """Layout change that moves leaves BETWEEN axes (fsdp -> tp): restored
+    values bit-equal and tp-sharded params land sharded, not replicated."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, Model, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, llama_tp_rules
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+    ids = np.random.default_rng(0).integers(0, 256, (8, 16), dtype=np.int32)
+
+    def build(pc):
+        _reset_state()
+        set_seed(0)
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+        module = LlamaForCausalLM(cfg)
+        acc = Accelerator(
+            parallelism_config=pc,
+            fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size_to_shard=0),
+            kwargs_handlers=[_elastic()],
+        )
+        model = Model.from_flax(
+            module, jax.random.key(0), ids, tp_rules=llama_tp_rules(True)
+        )
+        acc.prepare(model, optax.adamw(1e-3))
+        return acc
+
+    acc = build(ParallelismConfig(dp_shard_size=8))
+    want = _host_leaves(acc.train_state)
+    out = acc.save_state(str(tmp_path / "ck"))
+
+    acc2 = build(ParallelismConfig(dp_shard_size=4, tp_size=2))
+    acc2.load_state(out)
+    _assert_trees_bit_equal(acc2.train_state, want)
+    stats = acc2.elastic.last_stats
+    assert stats["moved_leaves"] > 0 and stats["host_staged"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Live plan migration
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_plan_requires_elastic():
+    from accelerate_tpu import ParallelismConfig
+
+    acc, *_ = _setup(ParallelismConfig(dp_shard_size=8))
+    with pytest.raises(RuntimeError, match="ElasticKwargs"):
+        acc.migrate_plan(ParallelismConfig(dp_shard_size=4, dp_replicate_size=2))
+
+
+def test_migrate_plan_live_bit_equal_and_trainable():
+    """migrate_plan reshards the live TrainState in place: values bit-equal,
+    new mesh installed, training continues under the new layout."""
+    import jax
+
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.resharding import mesh_axis_sizes
+
+    acc, module, loss_fn, batch = _setup(
+        ParallelismConfig(dp_shard_size=8), handlers=[_elastic()]
+    )
+    step = acc.prepare_train_step(loss_fn)
+    state, _ = step(acc.train_state, batch)
+    acc._train_state = state
+    want = _host_leaves(state)
+    old_step = int(np.asarray(state.step))
+
+    stats = acc.migrate_plan(ParallelismConfig(dp_replicate_size=2, dp_shard_size=4))
+    assert stats["moved_leaves"] > 0
+    assert stats["peak_batch_bytes"] <= acc.elastic.staging_budget_bytes
+
+    pc = acc.state.parallelism_config
+    assert pc.dp_shard_size == 4 and pc.dp_replicate_size == 2
+    got = acc.train_state
+    _assert_trees_bit_equal(got, want)
+    assert int(np.asarray(got.step)) == old_step
+    kernel = got.params["Dense_0"]["kernel"]
+    sizes = mesh_axis_sizes(kernel.sharding.mesh)
+    assert sizes["dp_shard"] == 4 and sizes["dp_replicate"] == 2
+
+    # The step fn keeps working on the migrated layout (jit retraces).
+    step2 = acc.prepare_train_step(loss_fn)
+    state2, m2 = step2(acc.train_state, batch)
+    assert np.isfinite(float(np.asarray(m2["loss"])))
+    assert int(np.asarray(state2.step)) == old_step + 1
+
+    # A failed migration must roll back to the old mesh.
+    with pytest.raises(Exception):
+        acc.migrate_plan(ParallelismConfig(dp_shard_size=5))  # 5 does not divide 8
+    assert acc.state.parallelism_config.dp_shard_size == 4
