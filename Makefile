@@ -7,7 +7,7 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
-        reshard-smoke
+        reshard-smoke disagg-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -90,6 +90,17 @@ warmup-smoke:
 # tokens/s on the same request set. See docs/usage_guides/serving.md.
 serving-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.serving_smoke
+
+# Disaggregated-serving gate: an open-loop Poisson trace of mixed-length
+# requests replays through the colocated engine and through the two-mesh
+# router (planner-sized prefill/decode slices on the 8-device CPU mesh,
+# streamed KV-page handoff). All requests must complete with rows bit-equal
+# between the paths, the disagg decode steady state must stay ONE executable
+# (zero post-warmup recompiles), the stats block must report real handoff
+# traffic, and disagg p95 TTFT must be STRICTLY lower than colocated at the
+# same offered load. See docs/usage_guides/serving.md "Disaggregated serving".
+disagg-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.disagg_smoke
 
 # Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
 # search must be deterministic (byte-identical JSON), every candidate must

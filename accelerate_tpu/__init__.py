@@ -82,8 +82,14 @@ from .generation import (  # noqa: E402
     register_generation_plan,
     sample_logits,
 )
-from .serving import ServingEngine  # noqa: E402
-from .utils.dataclasses import AutoPlanKwargs, ElasticKwargs, ServingConfig  # noqa: E402
+from .serving import ServingEngine, replay_trace  # noqa: E402
+from .disagg import DisaggServingEngine  # noqa: E402
+from .utils.dataclasses import (  # noqa: E402
+    AutoPlanKwargs,
+    DisaggConfig,
+    ElasticKwargs,
+    ServingConfig,
+)
 from .resharding import (  # noqa: E402
     ElasticManager,
     ReshardExecutor,

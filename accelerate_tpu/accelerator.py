@@ -77,6 +77,7 @@ from .utils import (
 from .utils.dataclasses import (
     AutoPlanKwargs,
     CompileKwargs,
+    DisaggConfig,
     DistributedDataParallelKwargs,
     ElasticKwargs,
     FaultToleranceKwargs,
@@ -203,6 +204,9 @@ class Accelerator:
         # Serving config (serving.py): stored only — no serving code runs on
         # the training path; build_serving_engine constructs the engine.
         self.serving_config = None
+        # Disaggregated-serving config (disagg.py): stored only; with one
+        # present, build_serving_engine returns the two-mesh router.
+        self.disagg_config = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_handler = handler
@@ -220,6 +224,8 @@ class Accelerator:
                 self.fault_tolerance_handler = handler
             elif isinstance(handler, ServingConfig):
                 self.serving_config = handler
+            elif isinstance(handler, DisaggConfig):
+                self.disagg_config = handler
             elif isinstance(handler, AutoPlanKwargs):
                 self.auto_plan_handler = handler
             elif isinstance(handler, ElasticKwargs):
@@ -1679,19 +1685,34 @@ class Accelerator:
             return None
         return self.compile_manager.warmup()
 
-    def build_serving_engine(self, model, config: Optional[ServingConfig] = None):
+    def build_serving_engine(self, model, config: Optional[ServingConfig] = None,
+                             disagg: Optional[DisaggConfig] = None):
         """Construct a :class:`~accelerate_tpu.serving.ServingEngine` over
         ``model`` (a prepared/loaded model with params on device), wired to
         this Accelerator's compile manager (prefill-chunk ladder, generation
         warmup) and telemetry recorder (serving block). ``config`` falls back
         to the :class:`~accelerate_tpu.utils.ServingConfig` handler passed at
         init; serving stays fully off — zero imports, zero hooks — without
-        one."""
+        one.
+
+        With a :class:`~accelerate_tpu.utils.DisaggConfig` — passed here or
+        as a kwargs handler — the engine upgrades to the two-mesh
+        :class:`~accelerate_tpu.disagg.DisaggServingEngine` (prefill and
+        decode on planner-sized disjoint device slices, KV pages streamed
+        between them). Disaggregation stays fully off without one."""
         cfg = config if config is not None else self.serving_config
         if cfg is None or not cfg.enabled:
             raise ValueError(
                 "serving is off: pass ServingConfig(...) here or in "
                 "Accelerator(kwargs_handlers=[...])."
+            )
+        dcfg = disagg if disagg is not None else self.disagg_config
+        if dcfg is not None and dcfg.enabled:
+            from .disagg import DisaggServingEngine
+
+            return DisaggServingEngine(
+                model, cfg, disagg=dcfg,
+                compile_manager=self.compile_manager, telemetry=self.telemetry,
             )
         from .serving import ServingEngine
 

@@ -1,0 +1,460 @@
+"""Disaggregated serving (layer L7 — inference serving, two meshes).
+
+The colocated :class:`~accelerate_tpu.serving.ServingEngine` already gets
+slot-paged KV, chunked prefill, and a zero-recompile decode step — but
+prefill and decode still share one device queue, so a long prompt burst
+stalls every in-flight decode and p95 TTFT spikes under open-loop load.
+This module is the DistServe/Splitwise-class fix, planner-shaped: partition
+the device set into a **prefill mesh** and a **decode mesh**, sized by
+:func:`~accelerate_tpu.planner.plan_disagg_slices` from the prefill:decode
+FLOP ratio, and stream each committed KV page across as a device-to-device
+transfer the moment its chunk lands.
+
+Architecture (MPMD one level up from arXiv:2412.14374's pipeline stages —
+two heterogeneous programs on disjoint device groups, a typed data plane
+between them):
+
+- **Prefill lanes** — each lane owns a private ``(L, 1, T_max, Hkv, D)``
+  slot cache pinned to one prefill device (round-robin over the slice) and
+  runs the SAME jitted prefill program as the colocated engine on it.
+  Identical program + identical inputs ⇒ the lane's KV values are
+  bit-equal to what an in-place prefill would have written.
+- **Streamed KV-page handoff** — after each chunk the lane's freshly
+  written page is sliced out and shipped to the decode placement with an
+  async ``jax.device_put``; the insert into the decode-side slot cache is
+  deferred behind a depth-``handoff_depth`` queue (the double buffer), so
+  a page's transfer overlaps the lane's NEXT chunk. The final chunk
+  flushes the queue and arms the slot, so decode never observes a
+  half-streamed prompt.
+- **Two-mesh router** — ``_admit`` grants a request a decode slot AND a
+  prefill lane; ``tick()`` advances every lane one chunk (lanes run
+  concurrently on their own devices) and then runs the unmodified decode
+  step on the decode mesh. The decode program, its donation pattern, and
+  its one-executable steady state are untouched — the router only changes
+  WHERE cache pages come from, never what they contain.
+
+Bit-equality with the single-mesh engine (pinned by tests/test_disagg.py):
+pages are copied pad-tail and all, attention is bounded at each row's true
+length, and every request samples from its own PRNG stream — so neither
+the transfer nor the two-mesh tick interleaving can change any token.
+
+CPU tier-1 story: force a multi-device host platform
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) and the same code
+splits the 8 "devices" into disjoint slices — the transfers are real
+cross-device copies, just over host memory.
+
+Usage::
+
+    from accelerate_tpu import DisaggConfig, DisaggServingEngine
+
+    engine = DisaggServingEngine(
+        model, ServingConfig(n_slots=8, eos_token_id=2),
+        disagg=DisaggConfig(n_prefill_lanes=2),
+    )
+    outs = engine.run(prompts, max_new_tokens=64)   # same API, same tokens
+    engine.stats()["disagg"]                        # slices + handoff costs
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, SingleDeviceSharding
+
+from .generation import KVCache, init_slot_cache
+from .logging import get_logger
+from .planner import BandwidthTable, kv_bytes_per_token, plan_disagg_slices
+from .serving import ServingEngine, SlotState, _cache_size, init_slot_state
+
+logger = get_logger(__name__)
+
+
+def _log_ok() -> bool:
+    """The repo logger needs accelerate state; the engine must also work
+    standalone (no Accelerator), where init-time logs are just skipped."""
+    from .state import PartialState
+
+    return bool(PartialState._shared_state)
+
+
+@dataclass
+class _Lane:
+    """One prefill workspace: a single-slot cache + state pinned to one
+    prefill device. A lane prefills one request at a time; ``cache`` and
+    ``state`` are rebound to the jitted program's (donated) outputs every
+    chunk, so the arrays live on ``device`` for the lane's lifetime."""
+
+    index: int
+    device: Any
+    params: Any
+    cache: KVCache
+    state: SlotState
+
+
+@dataclass
+class _Handoff:
+    """One committed KV page in flight to the decode mesh."""
+
+    slot: int
+    start: int            # write offset in the decode-side cache
+    valid: int            # real prompt tokens in the page (rest is pad tail)
+    pages: tuple          # (k_page, v_page) already device_put to decode
+    nbytes: int
+    arm: Optional[tuple] = None   # (tok, done0, rng_carry) on the final chunk
+    budget: int = 0
+    t0: Optional[float] = None    # perf_counter at dispatch when sampled
+
+
+class DisaggServingEngine(ServingEngine):
+    """Two-mesh router over the continuous-batching engine: chunked prefill
+    on a planner-sized prefill slice, the zero-recompile decode step on the
+    complementary decode slice, committed KV pages streamed between them.
+
+    Same front-end API as :class:`~accelerate_tpu.serving.ServingEngine`
+    (``submit/tick/poll/run``) and token-for-token the same outputs; the
+    extra ``disagg`` kwarg (a :class:`~accelerate_tpu.utils.DisaggConfig`)
+    and the ``devices`` override (default: ``jax.devices()``) control the
+    split. ``stats()`` gains a ``"disagg"`` block: the slice plan, handoff
+    bytes/latency, and measured FLOP ratio for re-planning.
+    """
+
+    def __init__(self, model, config=None, *, disagg=None, devices=None,
+                 forward_cached=None, compile_manager=None, telemetry=None):
+        from .utils.dataclasses import DisaggConfig
+
+        self.disagg_config = disagg if disagg is not None else DisaggConfig()
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < 2:
+            raise ValueError(
+                f"disaggregation needs >= 2 devices to split into a prefill "
+                f"and a decode mesh, got {len(devs)}; on CPU force a "
+                "multi-device host platform with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
+        super().__init__(model, config, forward_cached=forward_cached,
+                         compile_manager=compile_manager, telemetry=telemetry)
+        dc = self.disagg_config
+
+        # -- slice sizing (planner cost model) -----------------------------
+        ratio = dc.prefill_decode_flop_ratio
+        if ratio is None:
+            expected = (dc.expected_prompt_tokens
+                        if dc.expected_prompt_tokens is not None
+                        else max(1.0, self.t_max / 2.0))
+            ratio = expected / max(1, int(self.config.max_new_tokens))
+        kvb = kv_bytes_per_token(self.cfg, dtype=self._cache.k.dtype)
+        self.slice_plan = plan_disagg_slices(
+            len(devs), prefill_decode_flop_ratio=ratio,
+            bw=BandwidthTable.from_dict(dc.bandwidths),
+            kv_bytes_per_token=kvb, n_prefill=dc.n_prefill_devices,
+        )
+        self.prefill_devices = devs[:self.slice_plan.n_prefill]
+        self.decode_devices = devs[self.slice_plan.n_prefill:]
+
+        # -- decode mesh ---------------------------------------------------
+        # jit caches one executable PER PLACEMENT, so the one-executable
+        # decode invariant requires a FIXED decode placement. Default: the
+        # decode slice's first device hosts the slot cache (the census then
+        # reads exactly 1). Opt-in (shard_decode_slots): slots sharded over
+        # the decode slice — same single compiled program, but typed
+        # PRNG-key arrays under a multi-device NamedSharding occupy two
+        # dispatch-cache entries per program in jax 0.4.37, so init
+        # pre-warms both and the census reads a flat 2.
+        n_d = len(self.decode_devices)
+        if dc.shard_decode_slots and n_d > 1 and self.n_slots % n_d == 0:
+            self._decode_mesh = Mesh(
+                np.asarray(self.decode_devices), ("slots",))
+            cache_s = NamedSharding(self._decode_mesh, P(None, "slots"))
+            vec_s = NamedSharding(self._decode_mesh, P("slots"))
+            self._decode_sharding = NamedSharding(self._decode_mesh, P())
+        else:
+            if dc.shard_decode_slots and _log_ok():
+                logger.warning_once(
+                    "disagg: shard_decode_slots needs n_slots (%d) divisible "
+                    "by the decode slice (%d devices); falling back to "
+                    "single-device decode placement.", self.n_slots, n_d,
+                )
+            self._decode_mesh = None
+            cache_s = vec_s = self._decode_sharding = SingleDeviceSharding(
+                self.decode_devices[0])
+        self._cache = jax.device_put(
+            self._cache, KVCache(cache_s, cache_s, vec_s))
+        self._state = jax.device_put(
+            self._state, SlotState(*([vec_s] * len(SlotState._fields))))
+        self._params_decode = jax.device_put(model.params, self._decode_sharding)
+        self._params = self._params_decode  # what the decode hook dispatches
+
+        # -- prefill lanes -------------------------------------------------
+        params_by_dev: dict = {}
+        self._lanes: list[_Lane] = []
+        for i in range(int(dc.n_prefill_lanes)):
+            dev = self.prefill_devices[i % len(self.prefill_devices)]
+            if dev not in params_by_dev:
+                params_by_dev[dev] = jax.device_put(model.params, dev)
+            self._lanes.append(_Lane(
+                index=i, device=dev, params=params_by_dev[dev],
+                cache=jax.device_put(
+                    init_slot_cache(self.cfg, 1, self.t_max,
+                                    dtype=self.config.cache_dtype), dev),
+                state=jax.device_put(
+                    init_slot_state(1, seed=self.config.seed), dev),
+            ))
+        # FIFO lane reuse: grants take the least-recently-freed lane, so a
+        # request wave strides across every lane (and warmup covers each
+        # lane's device with every ladder rung).
+        self._free_lanes: deque[_Lane] = deque(self._lanes)
+
+        # -- the data plane ------------------------------------------------
+        self._handoffs: deque[_Handoff] = deque()
+        self._handoff_lat_s: list[float] = []
+        self._hstats = {"transfers": 0, "bytes": 0, "inserts": 0,
+                        "flushes": 0, "lane_chunks": 0}
+
+        # Page extract: slice the lane's freshly written page out of its
+        # (L, 1, T_max, Hkv, D) cache. One executable per ladder rung.
+        self._extract = jax.jit(
+            lambda k, v, start, size: (
+                jax.lax.dynamic_slice_in_dim(k, start, size, axis=2),
+                jax.lax.dynamic_slice_in_dim(v, start, size, axis=2),
+            ),
+            static_argnums=(3,),
+        )
+
+        # Page insert: write a transferred page into the decode-side slot
+        # cache at the request's own offset, and commit its true length.
+        def _insert(cache: KVCache, k_page, v_page, slot, start, valid):
+            zero = jnp.zeros((), jnp.int32)
+            k = jax.lax.dynamic_update_slice(
+                cache.k, k_page, (zero, slot, start, zero, zero))
+            v = jax.lax.dynamic_update_slice(
+                cache.v, v_page, (zero, slot, start, zero, zero))
+            return KVCache(k, v, cache.length.at[slot].set(start + valid))
+
+        self._insert = jax.jit(_insert, donate_argnums=(0,))
+
+        # Slot arming: once the final page has landed, publish the prefill
+        # step's terminal state for this slot — exactly the fields the
+        # colocated prefill's final chunk writes (garbage written by
+        # intermediate chunks is unobservable there too: active stays
+        # False until this moment).
+        def _arm(state: SlotState, slot, tok, done0, budget, carry):
+            return SlotState(
+                last_token=state.last_token.at[slot].set(tok),
+                active=state.active.at[slot].set(True),
+                done=state.done.at[slot].set(done0),
+                generated=state.generated.at[slot].set(1),
+                budget=state.budget.at[slot].set(budget),
+                rng=state.rng.at[slot].set(carry),
+            )
+
+        self._arm = jax.jit(_arm, donate_argnums=(0,))
+
+        if self._decode_mesh is not None:
+            # Pre-warm BOTH dispatch-cache entries the typed-key NamedSharding
+            # path occupies (one compiled program either way — see the
+            # shard_decode_slots note in DisaggConfig), so the steady-state
+            # census is flat from the first real tick. Safe for bit-equality:
+            # every slot is inactive, garbage KV lands below future inserts
+            # and past true lengths (never attended), and idle slots' rng
+            # streams are dead until _arm rewrites them.
+            for _ in range(4):
+                # No live rows: lengths pass through unchanged, k/v garbage
+                # lands where inserts overwrite or attention never reaches.
+                self._cache, self._state, _ = self._decode(
+                    self._params, self._cache, self._state)
+
+        if _log_ok():
+            logger.info(
+                "disagg: %d devices -> %d prefill / %d decode (ratio %.3g, "
+                "bottleneck %s, predicted speedup %.3gx), %d lane(s), "
+                "handoff %.3g GB/s",
+                self.slice_plan.n_devices, self.slice_plan.n_prefill,
+                self.slice_plan.n_decode, self.slice_plan.flop_ratio,
+                self.slice_plan.bottleneck, self.slice_plan.predicted_speedup,
+                len(self._lanes), self.slice_plan.handoff_gbps,
+            )
+
+    # -- router scheduling -------------------------------------------------
+
+    def tick(self) -> None:
+        """One router round: admit into free slots (same policy as the
+        colocated engine — lanes never gate admission, only prefill
+        concurrency), drain pages whose transfer had a full tick to fly,
+        advance EVERY lane-holding request one chunk (disjoint devices —
+        the chunks run concurrently), then one decode step on the decode
+        mesh."""
+        self._admit()
+        self._stats["queue_depth_sum"] += len(self._queue)
+        self._stats["queue_samples"] += 1
+        self._drain_handoffs()
+        for req in self._prefilling:
+            if not self._free_lanes:
+                break
+            if req.lane is None:
+                req.lane = self._free_lanes.popleft()
+        for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
+            for req in [r for r in self._prefilling if r.lane is not None]:
+                self._prefill_one(req)
+        if self._decoding:
+            self._decode_tick()
+        self._stats["ticks"] += 1
+
+    # -- prefill mesh + handoff --------------------------------------------
+
+    def _prefill_dispatch(self, req, chunk, valid: int,
+                          is_first: bool, is_final: bool):
+        """Run the chunk on the request's lane (prefill mesh), then stream
+        the committed page to the decode placement. The device_put is
+        async: the copy overlaps the lane's next chunk, and the insert is
+        deferred behind the handoff queue until it has had time to land."""
+        lane = req.lane
+        dc = self.disagg_config
+        start = req.consumed  # host-tracked — lane slot 0 IS this request
+        lane.cache, lane.state, tok, done0 = self._prefill(
+            lane.params, lane.cache, lane.state, chunk,
+            np.int32(0), np.int32(valid), np.int32(req.budget),
+            req.rng, is_first, is_final,
+        )
+        self._hstats["lane_chunks"] += 1
+
+        size = int(chunk.shape[1])
+        pages = self._extract(lane.cache.k, lane.cache.v, np.int32(start), size)
+        self._hstats["transfers"] += 1
+        t0 = None
+        if self._hstats["transfers"] % dc.handoff_sample_every == 0:
+            # Sampled end-to-end handoff timing: settle the source page so
+            # the clock starts at transfer dispatch, not at lane compute.
+            jax.block_until_ready(pages)
+            t0 = time.perf_counter()
+        pages_d = jax.device_put(pages, self._decode_sharding)
+        nbytes = int(pages[0].nbytes + pages[1].nbytes)
+        self._hstats["bytes"] += nbytes
+
+        arm = None
+        if is_final:
+            # The decode-side slot inherits the lane's terminal per-request
+            # state: first token, done flag, and the rng carry the final
+            # prefill chunk advanced to — decode then continues the SAME
+            # per-request stream the colocated engine would.
+            arm = jax.device_put(
+                (tok, done0, lane.state.rng[0]), self._decode_sharding)
+        self._handoffs.append(_Handoff(
+            slot=req.slot, start=start, valid=int(valid), pages=pages_d,
+            nbytes=nbytes, arm=arm, budget=int(req.budget), t0=t0,
+        ))
+        if is_final:
+            # Flush before decode can observe the slot, and release the
+            # lane — its buffers are donated to the next occupant's first
+            # chunk (XLA keeps pending readers safe).
+            self._drain_handoffs(drain_all=True)
+            self._hstats["flushes"] += 1
+            self._free_lanes.append(lane)
+            req.lane = None
+        else:
+            while len(self._handoffs) > dc.handoff_depth:
+                self._drain_one()
+        return tok, done0
+
+    def _drain_handoffs(self, drain_all: bool = False) -> None:
+        if drain_all:
+            while self._handoffs:
+                self._drain_one()
+        else:
+            # Pages queued on earlier ticks have had >= 1 tick of transfer
+            # time; keep at most the configured double buffer in flight.
+            while len(self._handoffs) > self.disagg_config.handoff_depth:
+                self._drain_one()
+
+    def _drain_one(self) -> None:
+        h = self._handoffs.popleft()
+        k_page, v_page = h.pages
+        self._cache = self._insert(
+            self._cache, k_page, v_page,
+            np.int32(h.slot), np.int32(h.start), np.int32(h.valid),
+        )
+        self._hstats["inserts"] += 1
+        if h.arm is not None:
+            tok, done0, carry = h.arm
+            self._state = self._arm(
+                self._state, np.int32(h.slot), tok, done0,
+                np.int32(h.budget), carry,
+            )
+        if h.t0 is not None:
+            jax.block_until_ready(k_page)
+            self._handoff_lat_s.append(time.perf_counter() - h.t0)
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the full two-mesh program set before real traffic: one
+        rung-walking request PER LANE (jit caches per placement, so every
+        lane device must see every ladder rung — prefill and extract alike),
+        which also compiles the per-rung inserts, the arm, and the decode
+        step. FIFO lane reuse guarantees coverage even when slots are
+        scarcer than lanes. Metrics reset afterwards."""
+        prompt_len = min(sum(self.ladder), self.t_max - 2)
+        prompt = np.ones((prompt_len,), np.int32)
+        self.run([prompt] * len(self._lanes), max_new_tokens=2)
+        self.reset_metrics()
+
+    def reset_metrics(self) -> None:
+        super().reset_metrics()
+        for k in self._hstats:
+            self._hstats[k] = 0
+        self._handoff_lat_s.clear()
+
+    # -- reporting ---------------------------------------------------------
+
+    def executable_counts(self) -> dict:
+        """Adds the data-plane programs to the base census. ``prefill`` is
+        now bounded by ``len(ladder) * n_prefill_devices`` (jit compiles
+        per placement); ``decode`` stays exactly 1 — the placement is
+        fixed, so the invariant survives the split."""
+        out = super().executable_counts()
+        out["handoff_extract"] = _cache_size(self._extract)
+        out["handoff_insert"] = _cache_size(self._insert)
+        out["slot_arm"] = _cache_size(self._arm)
+        return out
+
+    def stats(self) -> dict:
+        out = super().stats()
+        hs = self._hstats
+        lat = np.asarray(self._handoff_lat_s, np.float64)
+        s = self._stats
+        measured = (s["prompt_tokens_in"] / s["tokens_out"]
+                    if s["tokens_out"] else None)
+        out["disagg"] = {
+            "slice_plan": self.slice_plan.to_dict(),
+            "n_prefill_devices": len(self.prefill_devices),
+            "n_decode_devices": len(self.decode_devices),
+            "decode_slot_sharded": self._decode_mesh is not None,
+            "n_prefill_lanes": len(self._lanes),
+            "handoff_depth": int(self.disagg_config.handoff_depth),
+            "handoff_transfers": hs["transfers"],
+            "handoff_inserts": hs["inserts"],
+            "handoff_bytes": hs["bytes"],
+            "handoff_final_flushes": hs["flushes"],
+            "handoff_lat_sampled": int(lat.size),
+            "handoff_lat_mean_s": float(lat.mean()) if lat.size else None,
+            "handoff_lat_p95_s": (
+                float(np.percentile(lat, 95)) if lat.size else None),
+            # The ratio to feed back into DisaggConfig for the next run —
+            # the calibration loop the planner's cost model expects.
+            "measured_flop_ratio": (
+                round(measured, 6) if measured is not None else None),
+        }
+        return out
+
+    def _push_telemetry_summary(self) -> None:
+        super()._push_telemetry_summary()  # serving block (incl. "disagg")
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_disagg(self.stats()["disagg"])
+            except Exception as e:  # observability must never kill serving
+                logger.warning_once(f"disagg: telemetry summary failed: {e}")

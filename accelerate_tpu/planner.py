@@ -175,6 +175,13 @@ class BandwidthTable:
             return self.ici_gbps
         return self.ici_gbps if n_devices <= self.ici_domain else self.dcn_gbps
 
+    def handoff_gbps(self, n_devices: int) -> float:
+        """Bandwidth of the prefill→decode KV-page handoff link (disagg.py).
+        Both slices of a split that fits one ICI domain are ICI-adjacent;
+        a split spanning domains streams pages over DCN."""
+        link = self.ici_gbps if n_devices <= self.ici_domain else self.dcn_gbps
+        return link * self.collective_efficiency
+
 
 # ----------------------------------------------------------------------
 # Model profile (the divisibility constraints + roofline dims)
@@ -1051,3 +1058,119 @@ def record_calibration(
         logger.warning("planner: calibration write-back to %s failed: %s", path, e)
         return None
     return cal
+
+
+# ----------------------------------------------------------------------
+# Disaggregated-serving slice sizing (disagg.py)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DisaggSlicePlan:
+    """Planner-sized prefill/decode split for disaggregated serving
+    (disagg.py). The same makespan logic as the training cost model, one
+    level up: prefill and decode are two heterogeneous programs whose FLOP
+    shares are known, so the device set is partitioned to balance them —
+    and the KV-page handoff the split creates is priced against the
+    BandwidthTable so the artifact records what the link will carry."""
+
+    n_devices: int
+    n_prefill: int
+    n_decode: int
+    flop_ratio: float           # prefill FLOPs : decode FLOPs (per request)
+    bottleneck: str             # "prefill" | "decode" | "balanced"
+    predicted_speedup: float    # colocated makespan / disagg makespan
+    handoff_gbps: float         # effective prefill→decode link bandwidth
+    kv_bytes_per_token: int     # one token's K+V pages across all layers
+    handoff_s_per_ktoken: float  # predicted handoff seconds per 1k prompt tokens
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {
+            k: (_round6(v) if isinstance(v, float) else v)
+            for k, v in sorted(d.items())
+        }
+
+
+def kv_bytes_per_token(cfg, dtype=None) -> int:
+    """Bytes one prompt token's committed K+V pages occupy across every
+    layer — the unit the handoff link is priced in."""
+    from .generation import _cache_dims
+
+    layers, kv_heads, head_dim, _ = _cache_dims(cfg)
+    itemsize = np.dtype(dtype or getattr(cfg, "dtype", np.float32)).itemsize
+    return 2 * layers * kv_heads * head_dim * itemsize
+
+
+def plan_disagg_slices(
+    n_devices: int,
+    *,
+    prefill_decode_flop_ratio: float,
+    bw: Optional[BandwidthTable] = None,
+    kv_bytes_per_token: int = 0,
+    n_prefill: Optional[int] = None,
+) -> DisaggSlicePlan:
+    """Partition ``n_devices`` into a prefill slice and a decode slice.
+
+    ``prefill_decode_flop_ratio`` is the measured (or expected) ratio of
+    prefill FLOPs to decode FLOPs per request — for a dense causal LM both
+    phases cost ~2·P FLOPs/token, so the ratio reduces to
+    ``mean_prompt_tokens / mean_new_tokens``. The split minimizes the
+    two-phase makespan ``max(ratio / n_p, 1 / n_d)`` (work over devices,
+    phases overlapped across requests); ties break toward MORE decode
+    devices because decode is the latency-critical, occupancy-bound phase.
+    ``n_prefill`` pins the prefill slice size (clamped to [1, n-1]) and
+    skips the search.
+
+    The returned plan also prices the handoff the split creates:
+    ``handoff_gbps`` from the BandwidthTable's link model (ICI inside one
+    domain, DCN across) and ``handoff_s_per_ktoken`` for
+    ``kv_bytes_per_token`` (see :func:`kv_bytes_per_token`).
+    """
+    n = int(n_devices)
+    if n < 2:
+        raise PlannerError(
+            f"disaggregation needs >= 2 devices to split, got {n}"
+        )
+    r = float(prefill_decode_flop_ratio)
+    if not (r > 0):
+        raise PlannerError(
+            f"prefill_decode_flop_ratio must be > 0, got {prefill_decode_flop_ratio}"
+        )
+    bw = bw or BandwidthTable()
+
+    def makespan(p: int) -> float:
+        return max(r / p, 1.0 / (n - p))
+
+    if n_prefill is not None:
+        p_best = min(max(1, int(n_prefill)), n - 1)
+    else:
+        # Smallest p minimizing the makespan: scanning upward and keeping
+        # strict improvement biases ties toward more decode devices.
+        p_best, best = 1, makespan(1)
+        for p in range(2, n):
+            m = makespan(p)
+            if m < best - 1e-12:
+                p_best, best = p, m
+    span = makespan(p_best)
+    colocated = (r + 1.0) / n  # both phases time-sliced over every device
+    gbps = bw.handoff_gbps(n)
+    per_ktoken = (
+        1000.0 * kv_bytes_per_token / (gbps * 1e9) if kv_bytes_per_token else 0.0
+    )
+    prefill_span, decode_span = r / p_best, 1.0 / (n - p_best)
+    if abs(prefill_span - decode_span) <= 0.05 * span:
+        bottleneck = "balanced"
+    else:
+        bottleneck = "prefill" if prefill_span > decode_span else "decode"
+    return DisaggSlicePlan(
+        n_devices=n,
+        n_prefill=p_best,
+        n_decode=n - p_best,
+        flop_ratio=_round6(r),
+        bottleneck=bottleneck,
+        predicted_speedup=_round6(colocated / span),
+        handoff_gbps=_round6(gbps),
+        kv_bytes_per_token=int(kv_bytes_per_token),
+        handoff_s_per_ktoken=_round6(per_ktoken),
+    )

@@ -248,8 +248,8 @@ def _cache_size(fn) -> Optional[int]:
 
 class _Request:
     __slots__ = (
-        "id", "tokens", "budget", "rng", "slot", "chunks", "next_chunk",
-        "consumed", "out", "submit_t", "first_token_t", "done_t",
+        "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
+        "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -258,11 +258,13 @@ class _Request:
         self.budget = budget
         self.rng = rng
         self.slot = None
+        self.lane = None              # prefill lane (disagg.py router only)
         self.chunks = None            # [(chunk_size, valid)] once admitted
         self.next_chunk = 0
         self.consumed = 0             # prompt tokens already in the cache
         self.out: list[int] = []      # sampled continuation (incl. EOS)
         self.submit_t = time.perf_counter()
+        self.admit_t = None           # slot granted (TTFT = queue + prefill)
         self.first_token_t = None
         self.done_t = None
 
@@ -339,6 +341,10 @@ class ServingEngine:
             self.cfg, self.n_slots, self.t_max, dtype=c.cache_dtype
         )
         self._state = init_slot_state(self.n_slots, seed=c.seed)
+        # The param tree the dispatch hooks feed the jitted programs. The
+        # disaggregated router (disagg.py) repoints this at the decode-mesh
+        # copy; the colocated engine uses the model's own placement.
+        self._params = model.params
 
         self._queue: deque[_Request] = deque()
         self._prefilling: deque[_Request] = deque()
@@ -352,9 +358,14 @@ class ServingEngine:
         self._last_done_t: Optional[float] = None
         self._ttfts: list[float] = []
         self._tpots: list[float] = []
+        # TTFT attribution: time queued for a slot vs time prefilling once
+        # granted — the split that tells congestion from compute.
+        self._queue_waits: list[float] = []
+        self._prefill_lats: list[float] = []
         self._stats = {
             "submitted": 0, "completed": 0, "ticks": 0, "decode_steps": 0,
             "prefill_chunks": 0, "prefill_pad_tokens": 0, "tokens_out": 0,
+            "prompt_tokens_in": 0,
             "slot_allocs": 0, "slot_reuses": 0, "occupancy_sum": 0,
             "peak_occupancy": 0, "queue_depth_sum": 0, "queue_samples": 0,
             "steady_recompiles": 0,
@@ -419,35 +430,39 @@ class ServingEngine:
             self._decode_tick()
         self._stats["ticks"] += 1
 
+    def _grant(self, req: _Request, slot: int) -> None:
+        """Grant ``slot`` to ``req`` and move it onto the prefill queue —
+        shared by this scheduler and the disagg router's two-mesh _admit."""
+        req.slot = slot
+        req.admit_t = time.perf_counter()
+        req.chunks = plan_chunks(int(req.tokens.size), self.ladder)
+        self._stats["slot_allocs"] += 1
+        if slot in self._used_slots:
+            self._stats["slot_reuses"] += 1
+        self._used_slots.add(slot)
+        self._prefilling.append(req)
+
     def _admit(self) -> None:
         while self._free and self._queue:
-            req = self._queue.popleft()
-            slot = self._free.pop()
-            req.slot = slot
-            req.chunks = plan_chunks(int(req.tokens.size), self.ladder)
-            self._stats["slot_allocs"] += 1
-            if slot in self._used_slots:
-                self._stats["slot_reuses"] += 1
-            self._used_slots.add(slot)
-            self._prefilling.append(req)
+            self._grant(self._queue.popleft(), self._free.pop())
 
     def _prefill_one(self, req: _Request) -> None:
+        """Advance ``req`` by one prompt chunk: host bookkeeping here, device
+        work in :meth:`_prefill_dispatch` (the hook the disagg router
+        overrides to run the chunk on the prefill mesh and stream its KV
+        page across)."""
         size, valid = req.chunks[req.next_chunk]
         chunk = np.zeros((1, size), np.int32)
         chunk[0, :valid] = req.tokens[req.consumed:req.consumed + valid]
         is_first = req.next_chunk == 0
         is_final = req.next_chunk == len(req.chunks) - 1
-        self._cache, self._state, tok, done0 = self._prefill(
-            self.model.params, self._cache, self._state, chunk,
-            np.int32(req.slot), np.int32(valid), np.int32(req.budget),
-            req.rng, is_first, is_final,
-        )
+        tok, done0 = self._prefill_dispatch(req, chunk, valid, is_first, is_final)
         req.next_chunk += 1
         req.consumed += valid
         self._stats["prefill_chunks"] += 1
         self._stats["prefill_pad_tokens"] += size - valid
         if is_final:
-            self._prefilling.popleft()
+            self._prefilling.remove(req)
             req.first_token_t = time.perf_counter()
             req.out.append(int(tok))  # small host fetch — the TTFT moment
             if bool(done0):
@@ -455,9 +470,21 @@ class ServingEngine:
             else:
                 self._decoding[req.slot] = req
 
+    def _prefill_dispatch(self, req: _Request, chunk, valid: int,
+                          is_first: bool, is_final: bool):
+        """Device half of one prefill chunk: write it into the slot cache at
+        the request's own offset. Returns ``(first_token, done0)`` (device
+        scalars; only the final chunk's are fetched)."""
+        self._cache, self._state, tok, done0 = self._prefill(
+            self._params, self._cache, self._state, chunk,
+            np.int32(req.slot), np.int32(valid), np.int32(req.budget),
+            req.rng, is_first, is_final,
+        )
+        return tok, done0
+
     def _decode_tick(self) -> None:
         self._cache, self._state, tok = self._decode(
-            self.model.params, self._cache, self._state
+            self._params, self._cache, self._state
         )
         live = len(self._decoding)
         self._stats["decode_steps"] += 1
@@ -506,8 +533,12 @@ class ServingEngine:
         tpot = ((req.done_t - req.first_token_t) / (n_new - 1)) if n_new > 1 else 0.0
         self._ttfts.append(ttft)
         self._tpots.append(tpot)
+        if req.admit_t is not None:
+            self._queue_waits.append(req.admit_t - req.submit_t)
+            self._prefill_lats.append(req.first_token_t - req.admit_t)
         self._stats["completed"] += 1
         self._stats["tokens_out"] += n_new
+        self._stats["prompt_tokens_in"] += int(req.tokens.size)
         self._finished.append({
             "id": req.id, "tokens": row, "new_tokens": n_new,
             "ttft_s": ttft, "tpot_s": tpot,
@@ -553,6 +584,35 @@ class ServingEngine:
         self._push_telemetry_summary()
         return [results[i] for i in ids]
 
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile every steady-state program before real traffic: one
+        synthetic request whose prompt walks every ladder rung (greedy
+        chunking emits each rung once for a ``sum(ladder)``-length prompt)
+        plus one decode step. Metric counters are reset afterwards so a
+        timed run starts clean; dispatch-cache censuses are live state and
+        keep their (now fully warmed) sizes."""
+        prompt_len = min(sum(self.ladder), self.t_max - 2)
+        prompt = np.ones((prompt_len,), np.int32)
+        self.run([prompt], max_new_tokens=2)
+        self.reset_metrics()
+
+    def reset_metrics(self) -> None:
+        """Zero every latency/throughput metric (stats counters, TTFT/TPOT
+        samples, wall-clock anchors) without touching device state or the
+        compiled programs — the boundary between warmup and measurement."""
+        for k in self._stats:
+            self._stats[k] = 0
+        self._decode_executables_baseline = None
+        self._first_submit_t = None
+        self._last_done_t = None
+        self._ttfts.clear()
+        self._tpots.clear()
+        self._queue_waits.clear()
+        self._prefill_lats.clear()
+        self._finished.clear()
+
     # -- reporting ---------------------------------------------------------
 
     def executable_counts(self) -> dict:
@@ -578,12 +638,22 @@ class ServingEngine:
             "requests_submitted": s["submitted"],
             "requests_completed": s["completed"],
             "tokens_out": s["tokens_out"],
+            "prompt_tokens_in": s["prompt_tokens_in"],
             "elapsed_s": round(elapsed, 6) if elapsed else None,
             "tokens_per_s": (
                 round(s["tokens_out"] / elapsed, 3) if elapsed else None
             ),
             "ttft_p50_s": float(np.percentile(ttft, 50)) if ttft.size else None,
             "ttft_p95_s": float(np.percentile(ttft, 95)) if ttft.size else None,
+            # TTFT attribution: queued-for-a-slot vs prefilling-once-granted
+            # means — congestion vs compute (the disagg router exists to
+            # shrink the first term without starving the second).
+            "ttft_queue_wait_mean_s": (
+                float(np.mean(self._queue_waits)) if self._queue_waits else None
+            ),
+            "ttft_prefill_mean_s": (
+                float(np.mean(self._prefill_lats)) if self._prefill_lats else None
+            ),
             "tpot_mean_s": float(tpot.mean()) if tpot.size else None,
             "ticks": s["ticks"],
             "decode_steps": s["decode_steps"],
@@ -619,3 +689,48 @@ class ServingEngine:
         """Flush the serving summary into the telemetry stream (no device
         state to tear down — caches are plain donated arrays)."""
         self._push_telemetry_summary()
+
+
+# ---------------------------------------------------------------------------
+# Open-loop trace replay (shared by benchmarks, smokes, and the disagg router)
+# ---------------------------------------------------------------------------
+
+
+def replay_trace(engine: ServingEngine, prompts, *, arrivals,
+                 max_new_tokens=None, rngs=None) -> tuple[list, float]:
+    """Replay an open-loop arrival trace through a live engine: submit
+    ``prompts[i]`` once ``arrivals[i]`` seconds (monotone, from trace start)
+    have elapsed, tick until drained. Unlike :meth:`ServingEngine.run`, the
+    offered load is fixed by the trace, not by the engine's drain rate — the
+    setup TTFT-under-load comparisons (colocated vs disaggregated) need.
+
+    Returns ``(rows, elapsed_s)`` with one full prompt+continuation row per
+    prompt in input order.
+    """
+    n = len(prompts)
+    if len(arrivals) != n:
+        raise ValueError(f"{n} prompts but {len(arrivals)} arrivals")
+    budgets = (max_new_tokens if isinstance(max_new_tokens, (list, tuple))
+               else [max_new_tokens] * n)
+    keys = rngs if rngs is not None else [None] * n
+    order = sorted(range(n), key=lambda i: float(arrivals[i]))
+    ids: dict[int, int] = {}
+    results: dict[int, np.ndarray] = {}
+    t0 = time.perf_counter()
+    nxt = 0
+    while nxt < n or engine.pending:
+        now = time.perf_counter() - t0
+        while nxt < n and float(arrivals[order[nxt]]) <= now:
+            i = order[nxt]
+            ids[i] = engine.submit(prompts[i], max_new_tokens=budgets[i],
+                                   rng=keys[i])
+            nxt += 1
+        if engine.pending:
+            engine.tick()
+            for res in engine.poll():
+                results[res["id"]] = res["tokens"]
+        elif nxt < n:  # idle gap before the next arrival
+            time.sleep(min(0.002, max(0.0, float(arrivals[order[nxt]]) - now)))
+    elapsed = time.perf_counter() - t0
+    engine._push_telemetry_summary()
+    return [results[ids[i]] for i in range(n)], elapsed

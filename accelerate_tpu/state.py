@@ -99,6 +99,18 @@ def _maybe_init_jax_distributed():
         return
     if num <= 1:
         return
+    # Multi-process CPU gangs (--cpu / --virtual_devices) need an explicit
+    # cross-process collectives implementation: jax 0.4.37 defaults to
+    # "none", and the first device_put/jit that touches a sharding spanning
+    # the gang dies with "Multiprocess computations aren't implemented on
+    # the CPU backend". Gloo ships in jaxlib; opt in before any backend
+    # client exists. (JAX_CPU_COLLECTIVES_IMPLEMENTATION is not read from
+    # the environment in this jax version — it must go through jax.config.)
+    if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # jaxlib without gloo bindings: keep the default
     try:
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=num, process_id=idx

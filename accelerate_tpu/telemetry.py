@@ -167,6 +167,9 @@ class TelemetryRecorder:
         # Elastic reshard block (resharding.py): cumulative leaves/bytes/
         # depth/wall time across restores and live migrations this run.
         self._reshard_summary: Optional[dict] = None
+        # Disaggregated-serving block (disagg.py): slice plan, handoff
+        # bytes/latency, measured prefill:decode FLOP ratio.
+        self._disagg_summary: Optional[dict] = None
         # Auto-parallelism plan (planner.py): note_plan installs the active
         # plan; after _plan_calibrate_after steps the measured step time +
         # peak HBM are written back into the plan artifact (the calibration
@@ -578,6 +581,19 @@ class TelemetryRecorder:
             **self._serving_summary,
         })
 
+    def record_disagg(self, block: dict) -> None:
+        """Disaggregated-serving aggregate (disagg.py ``stats()["disagg"]``):
+        the planner slice plan, per-phase device counts, KV-page handoff
+        bytes + sampled latency, and the measured prefill:decode FLOP ratio
+        (the number to feed back into ``DisaggConfig`` — the serving twin of
+        the plan-calibration loop). Written as a JSONL record and embedded
+        as the summary's ``disagg`` block; last push wins."""
+        self._disagg_summary = dict(block)
+        self._write({
+            "event": "disagg_summary", "step": self.step, "time": time.time(),
+            **self._disagg_summary,
+        })
+
     # -- output ------------------------------------------------------------
 
     def _write(self, record: dict):
@@ -635,6 +651,10 @@ class TelemetryRecorder:
             # Elastic reshard block (resharding.py): leaves moved, bytes
             # transferred, schedule depth, wall time, staging budget.
             out["reshard"] = dict(self._reshard_summary)
+        if self._disagg_summary is not None:
+            # Disaggregated-serving block (disagg.py): slice plan + KV-page
+            # handoff bytes/latency; bench rows embed it alongside "serving".
+            out["disagg"] = dict(self._disagg_summary)
         plan_block = self.plan_block()
         if plan_block is not None:
             # Auto-parallelism plan block (planner.py): predicted vs

@@ -1,0 +1,180 @@
+"""`make disagg-smoke`: the disaggregated-serving acceptance loop on the CPU
+mesh.
+
+28 mixed-length, mixed-budget requests arrive as an open-loop Poisson trace
+(arrival times fixed up front — offered load does NOT adapt to either
+engine's drain rate) and replay twice through the same tiny Llama:
+
+- **colocated** — :class:`ServingEngine` on the default placement: one
+  device queue where every tick prefills ONE head-of-line chunk and then
+  pays a full ``n_slots``-wide decode step, so a burst of multi-chunk
+  prompts serializes behind the decode cadence and p95 TTFT spikes;
+- **disagg** — :class:`DisaggServingEngine` on the SAME 8-device host
+  platform split into planner-sized prefill/decode slices: every prefill
+  lane advances each tick and the freshly committed KV pages stream to the
+  decode mesh as cross-device copies.
+
+Asserts: every request completes on both paths; per-request rows are
+BIT-EQUAL between the two engines AND to gang-batched static
+``generate()``; the disagg decode steady state is ONE executable with zero
+post-warmup recompiles; the ``disagg`` stats block reports real handoff
+traffic (transfers, bytes, sampled latency); and the disagg p95 TTFT is
+STRICTLY lower than the colocated engine's on the same trace. Timing
+asserts get one re-measurement on warm engines before failing (open-loop
+wall-clock is noisy on shared CI cores).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+N_REQUESTS = 28
+N_SLOTS = 32
+N_LANES = 4
+
+
+def _workload(cfg):
+    """The head-of-line-blocking mix: ~30% multi-chunk prompts threaded
+    through a majority of single-chunk ones, Poisson arrivals."""
+    rng = np.random.default_rng(7)
+    lengths, prompts = [], []
+    for _ in range(N_REQUESTS):
+        if rng.random() < 0.3:
+            lengths.append(int(rng.integers(64, 97)))  # 3-4 ladder chunks
+        else:
+            lengths.append(int(rng.integers(6, 17)))   # one chunk
+    budgets = [int(rng.integers(12, 25)) for _ in range(N_REQUESTS)]
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lengths]
+    arrivals = np.cumsum(rng.exponential(0.003, size=N_REQUESTS)).tolist()
+    return prompts, budgets, arrivals
+
+
+def main():
+    print(json.dumps({"row": "start", "requests": N_REQUESTS}), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import (
+        DisaggConfig,
+        DisaggServingEngine,
+        Model,
+        ServingConfig,
+        ServingEngine,
+        generate,
+        replay_trace,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import set_seed
+
+    if len(jax.devices()) < 2:
+        raise SystemExit(
+            "disagg-smoke needs a multi-device platform; run via "
+            "`make disagg-smoke` (XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8)"
+        )
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+
+    prompts, budgets, arrivals = _workload(cfg)
+    keys = [jax.random.key(100 + i) for i in range(N_REQUESTS)]
+    sc = ServingConfig(n_slots=N_SLOTS, max_len=160, prefill_chunks=[16, 32],
+                       temperature=0.0, seed=0)
+
+    colo = ServingEngine(model, sc)
+    dis = DisaggServingEngine(model, sc,
+                              disagg=DisaggConfig(n_prefill_lanes=N_LANES))
+    colo.warmup()
+    dis.warmup()
+
+    def measure(engine):
+        engine.reset_metrics()
+        rows, _ = replay_trace(engine, prompts, arrivals=arrivals,
+                               max_new_tokens=budgets, rngs=keys)
+        return rows, engine.stats()
+
+    # One re-measurement before failing the timing bar: the trace itself is
+    # deterministic, but wall-clock on a shared CI core is not.
+    for attempt in range(2):
+        rows_c, s_c = measure(colo)
+        rows_d, s_d = measure(dis)
+        if s_d["ttft_p95_s"] < s_c["ttft_p95_s"]:
+            break
+
+    d = s_d["disagg"]
+    print(json.dumps({
+        "row": "colocated", "ttft_p50_s": round(s_c["ttft_p50_s"], 4),
+        "ttft_p95_s": round(s_c["ttft_p95_s"], 4),
+        "tokens_per_s": s_c["tokens_per_s"],
+        "decode_steps": s_c["decode_steps"],
+    }), flush=True)
+    print(json.dumps({
+        "row": "disagg", "ttft_p50_s": round(s_d["ttft_p50_s"], 4),
+        "ttft_p95_s": round(s_d["ttft_p95_s"], 4),
+        "tokens_per_s": s_d["tokens_per_s"],
+        "decode_steps": s_d["decode_steps"],
+        "slices": f"{d['n_prefill_devices']}p/{d['n_decode_devices']}d",
+        "handoff_transfers": d["handoff_transfers"],
+        "handoff_bytes": d["handoff_bytes"],
+        "handoff_lat_mean_s": d["handoff_lat_mean_s"],
+        "measured_flop_ratio": d["measured_flop_ratio"],
+    }), flush=True)
+
+    # --- Acceptance -------------------------------------------------------
+    assert s_c["requests_completed"] == N_REQUESTS, (
+        f"colocated completed {s_c['requests_completed']}/{N_REQUESTS}")
+    assert s_d["requests_completed"] == N_REQUESTS, (
+        f"disagg completed {s_d['requests_completed']}/{N_REQUESTS}")
+    mismatched = [i for i in range(N_REQUESTS)
+                  if not np.array_equal(rows_c[i], rows_d[i])]
+    assert not mismatched, f"disagg != colocated for requests {mismatched}"
+    # Static parity: gang-batched generate() over the same requests
+    # (left-padded to the batch max, decoded to the batch max budget — pads
+    # are masked, so per-request continuations must still match bit-for-bit).
+    static_bad = []
+    for i0 in range(0, N_REQUESTS, 8):
+        batch = list(range(i0, min(i0 + 8, N_REQUESTS)))
+        smax = max(len(prompts[i]) for i in batch)
+        bmax = max(budgets[i] for i in batch)
+        ids = np.zeros((len(batch), smax), np.int32)
+        mask = np.zeros((len(batch), smax), np.int32)
+        for r, i in enumerate(batch):
+            p = prompts[i]
+            ids[r, smax - len(p):] = p
+            mask[r, smax - len(p):] = 1
+        out = np.asarray(generate(model, ids, max_new_tokens=bmax,
+                                  attention_mask=mask))
+        for r, i in enumerate(batch):
+            want = out[r, smax:smax + budgets[i]]
+            got = rows_d[i][len(prompts[i]):len(prompts[i]) + budgets[i]]
+            if not np.array_equal(got, want):
+                static_bad.append(i)
+    assert not static_bad, f"disagg != static generate() for {static_bad}"
+    assert s_d["decode_executables"] == 1, (
+        f"disagg decode compiled {s_d['decode_executables']} executables, "
+        "want 1")
+    assert s_d["steady_recompiles"] == 0, (
+        f"{s_d['steady_recompiles']} steady-state recompiles, want 0")
+    assert d["handoff_transfers"] > 0 and d["handoff_bytes"] > 0, (
+        f"no handoff traffic recorded: {d}")
+    assert d["handoff_lat_sampled"] > 0, "no handoff latency samples"
+    assert s_d["ttft_p95_s"] < s_c["ttft_p95_s"], (
+        f"disagg p95 TTFT {s_d['ttft_p95_s']:.4f}s did not beat colocated "
+        f"{s_c['ttft_p95_s']:.4f}s at the same offered load")
+    print(json.dumps({
+        "row": "ok",
+        "p95_ttft_speedup": round(s_c["ttft_p95_s"] / s_d["ttft_p95_s"], 2),
+        "outputs_bit_equal": True,
+        "static_generate_bit_equal": True,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

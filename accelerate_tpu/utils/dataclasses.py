@@ -604,6 +604,75 @@ class ServingConfig(KwargsHandler):
 
 
 @dataclass
+class DisaggConfig(KwargsHandler):
+    """Disaggregated-serving config (disagg.py). OFF by default everywhere:
+    nothing splits the device set unless you construct a
+    :class:`~accelerate_tpu.disagg.DisaggServingEngine` — directly, or by
+    passing this handler to ``Accelerator(kwargs_handlers=[...])`` so
+    ``accelerator.build_serving_engine(model)`` upgrades the colocated
+    engine to the two-mesh router. Training and the colocated serving path
+    never touch this.
+
+    - ``n_prefill_devices``: pin the prefill-slice size; default ``None``
+      lets :func:`~accelerate_tpu.planner.plan_disagg_slices` size it from
+      the prefill:decode FLOP ratio against the planner's BandwidthTable.
+    - ``prefill_decode_flop_ratio``: measured prefill:decode FLOP ratio per
+      request. Default ``None`` estimates it as
+      ``expected_prompt_tokens / max_new_tokens`` (both phases cost ~2·P
+      FLOPs/token on a dense causal LM).
+    - ``expected_prompt_tokens``: expected mean prompt length for the ratio
+      estimate; default: half the serving slot capacity.
+    - ``n_prefill_lanes``: concurrent prefill workspaces on the prefill
+      slice — each lane owns a ``(L, 1, T_max, Hkv, D)`` cache pinned to a
+      prefill device (round-robin) and prefills one request at a time.
+    - ``handoff_depth``: committed KV pages a lane may keep in flight to
+      the decode mesh before the router drains the oldest — depth 2 is the
+      double-buffer that overlaps a chunk's transfer with the next chunk's
+      prefill.
+    - ``handoff_sample_every``: every Nth page transfer is timed end-to-end
+      (a sampled ``block_until_ready``) to feed the telemetry ``disagg``
+      block's handoff latency without stalling the pipeline on every page.
+    - ``bandwidths``: BandwidthTable field overrides for the slice-sizing
+      cost model (same dict shape as ``AutoPlanKwargs.bandwidths``).
+    - ``shard_decode_slots``: shard the decode-side slot cache across the
+      decode slice (requires ``n_slots % n_decode == 0``) instead of
+      hosting it on the slice's first device. Off by default: jitted
+      programs taking typed PRNG-key arrays under a multi-device
+      NamedSharding occupy TWO dispatch-cache entries for ONE compiled
+      executable (jax 0.4.37), so the sharded path reports
+      ``decode_executables == 2`` even though exactly one program is ever
+      compiled; the engine pre-warms both entries at init so the census
+      stays flat (``steady_recompiles == 0``) either way.
+    """
+
+    enabled: bool = True
+    n_prefill_devices: Optional[int] = None
+    prefill_decode_flop_ratio: Optional[float] = None
+    expected_prompt_tokens: Optional[float] = None
+    n_prefill_lanes: int = 2
+    handoff_depth: int = 2
+    handoff_sample_every: int = 8
+    bandwidths: Optional[dict] = None
+    shard_decode_slots: bool = False
+
+    def __post_init__(self):
+        if self.n_prefill_devices is not None and self.n_prefill_devices < 1:
+            raise ValueError("n_prefill_devices must be >= 1")
+        if (self.prefill_decode_flop_ratio is not None
+                and not self.prefill_decode_flop_ratio > 0):
+            raise ValueError("prefill_decode_flop_ratio must be > 0")
+        if (self.expected_prompt_tokens is not None
+                and not self.expected_prompt_tokens > 0):
+            raise ValueError("expected_prompt_tokens must be > 0")
+        if self.n_prefill_lanes < 1:
+            raise ValueError("n_prefill_lanes must be >= 1")
+        if self.handoff_depth < 1:
+            raise ValueError("handoff_depth must be >= 1")
+        if self.handoff_sample_every < 1:
+            raise ValueError("handoff_sample_every must be >= 1")
+
+
+@dataclass
 class JitConfig(KwargsHandler):
     """Compilation policy — the role of the reference's TorchDynamoPlugin
     (reference: utils/dataclasses.py:1031-1118). XLA jit is always on; these

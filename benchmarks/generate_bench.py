@@ -18,9 +18,14 @@ Three rows, one JSON line each:
   gang-scheduled static-batch ``generate()`` — aggregate tokens/s, p50/p95
   TTFT (static TTFT = batch completion minus arrival: requests wait for
   the gang), and recompile/executable counts per phase.
+- ``--disagg`` (implies ``--serving``) adds a ``serving_disagg`` row: the
+  same Poisson trace through the two-mesh
+  :class:`~accelerate_tpu.disagg.DisaggServingEngine` (planner-sized
+  prefill/decode slices, streamed KV-page handoff) with the telemetry
+  ``disagg`` block embedded in the row.
 
     python benchmarks/generate_bench.py [--params-b 1] [--new-tokens 64]
-                                        [--serving] [--qps 8]
+                                        [--serving] [--disagg] [--qps 8]
 """
 
 import argparse
@@ -69,11 +74,18 @@ def main():
                     help="add a resident_int8 row (DecodeQuant weight-only decode)")
     ap.add_argument("--serving", action="store_true",
                     help="add serving rows (continuous batching vs static gang)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="add a disaggregated-serving row (two-mesh router on "
+                         "the same Poisson trace; implies --serving)")
+    ap.add_argument("--lanes", type=int, default=4,
+                    help="prefill lanes for the --disagg row")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--qps", type=float, default=8.0,
                     help="Poisson arrival rate for the serving rows")
     args = ap.parse_args()
+    if args.disagg:
+        args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
     # parseable row the moment anything is known, flushed — a driver timeout
@@ -225,26 +237,18 @@ def main():
             "compiled_executables": static_execs,
         }), flush=True)
 
-        # Continuous batching: Poisson arrivals submitted in real time.
+        # Continuous batching: the SAME Poisson trace replayed open-loop
+        # (arrival times fixed up front — offered load does not adapt to the
+        # engine's drain rate). Warmup first so compiles stay out of TTFT.
+        from accelerate_tpu.serving import replay_trace
+
         t_cap = int(max(lengths[i] + budgets[i] for i in range(n))) + 8
-        engine = ServingEngine(
-            res_model,
-            ServingConfig(n_slots=slots, max_len=t_cap,
-                          max_prefill_chunk=max(16, args.prompt_len)),
-        )
-        t0 = time.perf_counter()
-        nxt = 0
-        while nxt < n or engine.pending:
-            now = time.perf_counter() - t0
-            while nxt < n and arrivals[nxt] <= now:
-                engine.submit(reqs[nxt], max_new_tokens=int(budgets[nxt]))
-                nxt += 1
-            if engine.pending:
-                engine.tick()
-                engine.poll()
-            elif nxt < n:
-                time.sleep(min(0.01, max(0.0, arrivals[nxt] - now)))
-        serve_s = time.perf_counter() - t0
+        scfg = ServingConfig(n_slots=slots, max_len=t_cap,
+                             max_prefill_chunk=max(16, args.prompt_len))
+        engine = ServingEngine(res_model, scfg)
+        engine.warmup()
+        _, serve_s = replay_trace(engine, reqs, arrivals=list(arrivals),
+                                  max_new_tokens=[int(b) for b in budgets])
         st = engine.stats()
         print(json.dumps({
             "row": "serving", "seconds": round(serve_s, 3),
@@ -258,6 +262,36 @@ def main():
             "prefill_executables": st["prefill_executables"],
             "steady_recompiles": st["steady_recompiles"],
         }), flush=True)
+
+        # Disaggregated row: the same trace through the two-mesh router —
+        # planner-sized prefill/decode slices, streamed KV-page handoff. The
+        # telemetry `disagg` block rides inside the row (slice plan, handoff
+        # bytes/latency, measured FLOP ratio).
+        if args.disagg and len(jax.devices()) < 2:
+            print(json.dumps({
+                "row": "serving_disagg", "skipped": "needs >= 2 devices",
+            }), flush=True)
+        elif args.disagg:
+            from accelerate_tpu import DisaggConfig, DisaggServingEngine
+
+            dengine = DisaggServingEngine(
+                res_model, scfg, disagg=DisaggConfig(n_prefill_lanes=args.lanes),
+            )
+            dengine.warmup()
+            _, dis_s = replay_trace(dengine, reqs, arrivals=list(arrivals),
+                                    max_new_tokens=[int(b) for b in budgets])
+            dst = dengine.stats()
+            print(json.dumps({
+                "row": "serving_disagg", "seconds": round(dis_s, 3),
+                "useful_tokens": dst["tokens_out"],
+                "tokens_per_s": dst["tokens_per_s"],
+                "ttft_p50_s": round(dst["ttft_p50_s"], 4),
+                "ttft_p95_s": round(dst["ttft_p95_s"], 4),
+                "tpot_mean_s": round(dst["tpot_mean_s"], 4),
+                "decode_executables": dst["decode_executables"],
+                "steady_recompiles": dst["steady_recompiles"],
+                "disagg": dst["disagg"],
+            }), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)
