@@ -7,7 +7,7 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
-        reshard-smoke disagg-smoke
+        reshard-smoke disagg-smoke chaos-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -101,6 +101,18 @@ serving-smoke:
 # same offered load. See docs/usage_guides/serving.md "Disaggregated serving".
 disagg-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.disagg_smoke
+
+# Serving-under-fire gate: a 32-request Poisson trace (tick-driven, fully
+# deterministic) replays through the disagg engine fault-free and twice under
+# an identical FaultInjector spec (one dead prefill lane, a poisoned KV page,
+# rate-driven handoff transfer errors). No hang (idle-tick guard armed),
+# every request ends with an explicit status, ok rows are bit-equal to the
+# fault-free run, decode stays ONE executable with 0 steady recompiles, chaos
+# p95 TTFT stays within 5x fault-free, and the second chaos run reproduces
+# the first's fault schedule/statuses/rows exactly. See
+# docs/usage_guides/serving.md "Serving under faults".
+chaos-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.chaos_smoke
 
 # Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
 # search must be deterministic (byte-identical JSON), every candidate must

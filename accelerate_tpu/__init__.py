@@ -82,8 +82,9 @@ from .generation import (  # noqa: E402
     register_generation_plan,
     sample_logits,
 )
-from .serving import ServingEngine, replay_trace  # noqa: E402
+from .serving import ServingEngine, ServingStalledError, replay_trace  # noqa: E402
 from .disagg import DisaggServingEngine  # noqa: E402
+from .chaos import Fault, FaultInjector, InjectedFaultError  # noqa: E402
 from .utils.dataclasses import (  # noqa: E402
     AutoPlanKwargs,
     DisaggConfig,

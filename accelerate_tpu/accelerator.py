@@ -1686,7 +1686,8 @@ class Accelerator:
         return self.compile_manager.warmup()
 
     def build_serving_engine(self, model, config: Optional[ServingConfig] = None,
-                             disagg: Optional[DisaggConfig] = None):
+                             disagg: Optional[DisaggConfig] = None, *,
+                             chaos=None):
         """Construct a :class:`~accelerate_tpu.serving.ServingEngine` over
         ``model`` (a prepared/loaded model with params on device), wired to
         this Accelerator's compile manager (prefill-chunk ladder, generation
@@ -1699,7 +1700,14 @@ class Accelerator:
         as a kwargs handler — the engine upgrades to the two-mesh
         :class:`~accelerate_tpu.disagg.DisaggServingEngine` (prefill and
         decode on planner-sized disjoint device slices, KV pages streamed
-        between them). Disaggregation stays fully off without one."""
+        between them). Disaggregation stays fully off without one.
+
+        The Accelerator's fault-tolerance manager (when armed via
+        :class:`~accelerate_tpu.utils.FaultToleranceKwargs`) is wired in
+        too: a SIGTERM mid-serving triggers the engine's preemption drain
+        (finish in-flight, shed the queue, report exit code 75).
+        ``chaos`` takes a :class:`~accelerate_tpu.chaos.FaultInjector` for
+        deterministic fault-injection runs."""
         cfg = config if config is not None else self.serving_config
         if cfg is None or not cfg.enabled:
             raise ValueError(
@@ -1713,12 +1721,14 @@ class Accelerator:
             return DisaggServingEngine(
                 model, cfg, disagg=dcfg,
                 compile_manager=self.compile_manager, telemetry=self.telemetry,
+                fault_tolerance=self.fault_tolerance, chaos=chaos,
             )
         from .serving import ServingEngine
 
         return ServingEngine(
             model, cfg,
             compile_manager=self.compile_manager, telemetry=self.telemetry,
+            fault_tolerance=self.fault_tolerance, chaos=chaos,
         )
 
     def _comm_hook_step(

@@ -1,0 +1,256 @@
+"""Deterministic fault injection for the serving stack (layer L7).
+
+Production characterizations of distributed DL deployments show failure
+behavior under load — not peak throughput — dominates deployed performance
+(arXiv:2505.12832, PAPERS.md). The serving engines therefore carry a
+request-lifecycle robustness layer (admission control, retries, lane
+quarantine, degraded fallback — serving.py / disagg.py), and THIS module is
+how that layer gets exercised: a seed-driven :class:`FaultInjector` whose
+schedule is **fully determined by ``(seed, injection_point, tick)``** — no
+wall-clock, no global RNG — so a chaos run replays exactly, twice, anywhere.
+
+Injection points (registered by the engines at the four places a real
+deployment fails):
+
+- ``prefill_dispatch`` — the jitted prefill chunk dispatch (colocated slot
+  write, or a disagg lane's private cache write);
+- ``decode_tick`` — the steady-state decode step (poisons a live slot's KV
+  page so the nonfinite-logits sentinel path runs);
+- ``handoff_device_put`` — the disagg KV-page transfer to the decode mesh;
+- ``lane_health`` — a prefill lane's liveness check at dispatch.
+
+Fault kinds:
+
+- ``transfer_error`` — a raised transfer/dispatch error (``u < 0.75``:
+  transient, one failed attempt; else persistent — every retry fails, which
+  is how the lane-quarantine path gets coverage without a scheduled fault);
+- ``delay`` — a straggler handoff: the page's background insert is deferred
+  ``delay_ticks`` ticks (forced drains — depth overflow, the final-chunk
+  flush — still complete it, exactly like awaiting a slow async transfer);
+- ``dead_lane`` — the lane is dead: dispatch raises and the engine
+  quarantines it;
+- ``poison`` — a nonfinite (NaN) KV page: the transferred page (or, at
+  ``decode_tick``, a live slot's page in place) is overwritten with NaN,
+  which the decode-side sentinel must catch.
+
+Off by default everywhere: no injector exists unless you construct one and
+pass it to an engine (``ServingEngine(..., chaos=...)``); the import is
+lazy-safe (numpy only) and the serving hot path holds a single ``is None``
+check per site.
+
+Usage::
+
+    from accelerate_tpu import FaultInjector, ServingConfig
+
+    chaos = FaultInjector(
+        seed=7,
+        rates={"handoff_device_put": {"transfer_error": 0.05}},
+        schedule=[{"point": "lane_health", "kind": "dead_lane", "unit": 0}],
+    )
+    engine = DisaggServingEngine(model, cfg, disagg=dc, chaos=chaos)
+    engine.run(prompts)
+    chaos.injected        # the exact (tick, point, kind, unit) log — replay
+                          # with the same seed and it is identical
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import NamedTuple, Optional
+
+__all__ = [
+    "Fault",
+    "FaultInjector",
+    "InjectedFaultError",
+    "INJECTION_POINTS",
+    "FAULT_KINDS",
+    "deterministic_jitter",
+]
+
+INJECTION_POINTS = (
+    "prefill_dispatch",
+    "decode_tick",
+    "handoff_device_put",
+    "lane_health",
+)
+
+FAULT_KINDS = ("transfer_error", "delay", "dead_lane", "poison")
+
+# Which kinds make sense where — rates naming other combos are rejected at
+# construction so a typo'd chaos spec fails loudly, not silently-never-fires.
+_POINT_KINDS = {
+    "prefill_dispatch": ("transfer_error",),
+    "decode_tick": ("poison",),
+    "handoff_device_put": ("transfer_error", "delay", "poison"),
+    "lane_health": ("dead_lane",),
+}
+
+_MASK = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """The splitmix64 finalizer — the counter-based PRNG core that makes a
+    draw a pure function of its inputs."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return (z ^ (z >> 31)) & _MASK
+
+
+def _u01(*parts) -> float:
+    """Uniform in [0, 1) from an arbitrary (seed, str/int, ...) tuple —
+    deterministic across processes and platforms (no hash randomization:
+    strings go through crc32)."""
+    h = 0
+    for p in parts:
+        if isinstance(p, str):
+            p = zlib.crc32(p.encode("utf-8"))
+        h = _splitmix64((h ^ (int(p) & _MASK)) & _MASK)
+    return h / float(1 << 64)
+
+
+def deterministic_jitter(seed: int, tick: int, attempt: int) -> float:
+    """Jitter factor in [0.5, 1.0) for retry backoff — deterministic in its
+    inputs so a chaos replay backs off identically."""
+    return 0.5 + 0.5 * _u01(seed, "backoff", tick, attempt)
+
+
+class Fault(NamedTuple):
+    """One drawn fault. ``u`` is the residual uniform the engine uses for
+    deterministic sub-decisions (e.g. transient vs persistent transfer
+    errors) without another RNG."""
+
+    point: str
+    kind: str
+    tick: int
+    unit: int
+    u: float
+
+
+class InjectedFaultError(RuntimeError):
+    """Raised at an injection site to model a transfer/dispatch failure.
+    Subclasses RuntimeError so the engines' recovery paths treat injected
+    and real (XLA runtime) failures identically."""
+
+    def __init__(self, fault: Fault):
+        super().__init__(
+            f"injected {fault.kind} at {fault.point} "
+            f"(tick {fault.tick}, unit {fault.unit})"
+        )
+        self.fault = fault
+
+
+class FaultInjector:
+    """Seed-driven deterministic fault schedule.
+
+    - ``rates``: ``{point: {kind: probability}}`` (or ``{point: prob}``,
+      which takes the point's first legal kind). Each ``draw(point, tick,
+      unit)`` maps ``(seed, point, tick, unit)`` through a counter-based
+      hash to one uniform — no draw ever observes another draw, so the
+      schedule is independent of call order and replays exactly.
+    - ``schedule``: explicit one-shot faults —
+      ``{"point", "kind", "tick"?, "unit"?, "count"?}``. Omitted ``tick`` /
+      ``unit`` match the first opportunity; ``count`` (default 1) fires the
+      entry that many times. The smoke uses this for "one dead prefill
+      lane".
+    - ``delay_ticks``: how many ticks a ``delay`` fault defers a handoff's
+      background insert.
+
+    ``injected`` logs every fault actually drawn, in draw order — two runs
+    with the same seed, config, and trace produce identical logs (pinned by
+    tests/test_chaos.py and ``make chaos-smoke``).
+    """
+
+    def __init__(self, seed: int = 0, rates: Optional[dict] = None,
+                 schedule: Optional[list] = None, delay_ticks: int = 3):
+        self.seed = int(seed)
+        self.delay_ticks = int(delay_ticks)
+        if self.delay_ticks < 1:
+            raise ValueError(f"delay_ticks must be >= 1, got {delay_ticks}")
+        self.rates: dict[str, dict[str, float]] = {}
+        for point, spec in (rates or {}).items():
+            if point not in INJECTION_POINTS:
+                raise ValueError(
+                    f"unknown injection point {point!r}; known: "
+                    f"{INJECTION_POINTS}"
+                )
+            legal = _POINT_KINDS[point]
+            if not isinstance(spec, dict):
+                spec = {legal[0]: float(spec)}
+            for kind, prob in spec.items():
+                if kind not in legal:
+                    raise ValueError(
+                        f"fault kind {kind!r} is not injectable at {point!r}; "
+                        f"legal: {legal}"
+                    )
+                if not 0.0 <= float(prob) <= 1.0:
+                    raise ValueError(
+                        f"probability for {point}/{kind} must be in [0, 1], "
+                        f"got {prob}"
+                    )
+            total = sum(float(p) for p in spec.values())
+            if total > 1.0:
+                raise ValueError(
+                    f"probabilities at {point!r} sum to {total} > 1"
+                )
+            self.rates[point] = {k: float(v) for k, v in spec.items()}
+        self._schedule: list[dict] = []
+        for entry in (schedule or []):
+            e = dict(entry)
+            point, kind = e.get("point"), e.get("kind")
+            if point not in INJECTION_POINTS:
+                raise ValueError(f"schedule entry has unknown point {point!r}")
+            if kind not in _POINT_KINDS[point]:
+                raise ValueError(
+                    f"schedule entry {kind!r} not injectable at {point!r}; "
+                    f"legal: {_POINT_KINDS[point]}"
+                )
+            e.setdefault("count", 1)
+            self._schedule.append(e)
+        self.injected: list[dict] = []
+
+    # -- the draw ----------------------------------------------------------
+
+    def draw(self, point: str, tick: int, unit: int = 0) -> Optional[Fault]:
+        """One fault decision at ``point`` on scheduler ``tick`` for ``unit``
+        (a lane index / request id — disambiguates multiple same-point draws
+        within one tick). Returns the :class:`Fault` or None."""
+        tick, unit = int(tick), int(unit)
+        u = _u01(self.seed, point, tick, unit)
+        # Explicit schedule first: the one-shot faults a test pins exactly.
+        for entry in self._schedule:
+            if entry["count"] <= 0 or entry["point"] != point:
+                continue
+            if entry.get("tick") is not None and int(entry["tick"]) != tick:
+                continue
+            if entry.get("unit") is not None and int(entry["unit"]) != unit:
+                continue
+            entry["count"] -= 1
+            return self._log(Fault(point, entry["kind"], tick, unit, u))
+        # Rate-driven: walk the point's kinds in declaration order against
+        # the single uniform — cumulative, so at most one kind fires.
+        acc = 0.0
+        for kind, prob in self.rates.get(point, {}).items():
+            acc += prob
+            if u < acc:
+                return self._log(Fault(point, kind, tick, unit, u))
+        return None
+
+    def _log(self, fault: Fault) -> Fault:
+        self.injected.append({
+            "tick": fault.tick, "point": fault.point, "kind": fault.kind,
+            "unit": fault.unit,
+        })
+        return fault
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Counts by (point, kind) plus the full ordered log length — the
+        chaos side of the telemetry ``faults`` block."""
+        by: dict[str, int] = {}
+        for f in self.injected:
+            key = f"{f['point']}:{f['kind']}"
+            by[key] = by.get(key, 0) + 1
+        return {"injected": len(self.injected), "by_site": dict(sorted(by.items()))}

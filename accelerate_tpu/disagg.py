@@ -67,6 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, SingleDeviceSharding
 
+from .chaos import InjectedFaultError, deterministic_jitter
 from .generation import KVCache, init_slot_cache
 from .logging import get_logger
 from .planner import BandwidthTable, kv_bytes_per_token, plan_disagg_slices
@@ -109,6 +110,9 @@ class _Handoff:
     arm: Optional[tuple] = None   # (tok, done0, rng_carry) on the final chunk
     budget: int = 0
     t0: Optional[float] = None    # perf_counter at dispatch when sampled
+    ready_tick: int = 0   # straggler model: background drains wait for this
+                          # tick; forced drains (depth overflow, final flush)
+                          # await the transfer and proceed
 
 
 class DisaggServingEngine(ServingEngine):
@@ -125,7 +129,8 @@ class DisaggServingEngine(ServingEngine):
     """
 
     def __init__(self, model, config=None, *, disagg=None, devices=None,
-                 forward_cached=None, compile_manager=None, telemetry=None):
+                 forward_cached=None, compile_manager=None, telemetry=None,
+                 fault_tolerance=None, chaos=None):
         from .utils.dataclasses import DisaggConfig
 
         self.disagg_config = disagg if disagg is not None else DisaggConfig()
@@ -138,8 +143,14 @@ class DisaggServingEngine(ServingEngine):
                 "XLA_FLAGS=--xla_force_host_platform_device_count=N"
             )
         super().__init__(model, config, forward_cached=forward_cached,
-                         compile_manager=compile_manager, telemetry=telemetry)
+                         compile_manager=compile_manager, telemetry=telemetry,
+                         fault_tolerance=fault_tolerance, chaos=chaos)
         dc = self.disagg_config
+        # Degradation state: quarantined lanes leave the pool for good; once
+        # EVERY lane is gone the engine latches degraded and prefills
+        # colocated on the decode mesh (correct, slower — traffic survives).
+        self._quarantined_lanes: set[int] = set()
+        self._degraded = False
 
         # -- slice sizing (planner cost model) -----------------------------
         ratio = dc.prefill_decode_flop_ratio
@@ -266,7 +277,7 @@ class DisaggServingEngine(ServingEngine):
             for _ in range(4):
                 # No live rows: lengths pass through unchanged, k/v garbage
                 # lands where inserts overwrite or attention never reaches.
-                self._cache, self._state, _ = self._decode(
+                self._cache, self._state, _, _ = self._decode(
                     self._params, self._cache, self._state)
 
         if _log_ok():
@@ -283,27 +294,56 @@ class DisaggServingEngine(ServingEngine):
     # -- router scheduling -------------------------------------------------
 
     def tick(self) -> None:
-        """One router round: admit into free slots (same policy as the
-        colocated engine — lanes never gate admission, only prefill
-        concurrency), drain pages whose transfer had a full tick to fly,
-        advance EVERY lane-holding request one chunk (disjoint devices —
-        the chunks run concurrently), then one decode step on the decode
-        mesh."""
+        """One router round: sweep deadlines/preemption, admit into free
+        slots (same policy as the colocated engine — lanes never gate
+        admission, only prefill concurrency), drain pages whose transfer had
+        a full tick to fly, advance EVERY lane-holding request one chunk
+        (disjoint devices — the chunks run concurrently), then one decode
+        step on the decode mesh. Degraded mode (every lane quarantined)
+        prefills head-of-line colocated on the decode mesh instead."""
+        snap = self._begin_tick()
         self._admit()
         self._stats["queue_depth_sum"] += len(self._queue)
         self._stats["queue_samples"] += 1
         self._drain_handoffs()
-        for req in self._prefilling:
-            if not self._free_lanes:
-                break
-            if req.lane is None:
-                req.lane = self._free_lanes.popleft()
+        if not self._degraded:
+            self._assign_lanes()
         for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
-            for req in [r for r in self._prefilling if r.lane is not None]:
-                self._prefill_one(req)
+            if self._degraded:
+                # Colocated fallback: the base head-of-line discipline, the
+                # base dispatch path (lane is None routes there).
+                if not self._prefilling:
+                    break
+                self._prefill_one(self._prefilling[0])
+            else:
+                runnable = [r for r in self._prefilling if r.lane is not None]
+                if not runnable:
+                    break
+                for req in runnable:
+                    self._prefill_one(req)
         if self._decoding:
             self._decode_tick()
-        self._stats["ticks"] += 1
+        self._end_tick(snap)
+
+    def _assign_lanes(self) -> None:
+        """Hand free lanes to lane-less prefilling requests, health-checking
+        each lane at grant time (the ``lane_health`` injection point — a
+        dead lane is quarantined before it ever touches a request)."""
+        for req in list(self._prefilling):
+            if req.lane is not None:
+                continue
+            while self._free_lanes and req.lane is None:
+                lane = self._free_lanes.popleft()
+                if self.chaos is not None:
+                    fault = self.chaos.draw("lane_health",
+                                            self._stats["ticks"],
+                                            unit=lane.index)
+                    if fault is not None and fault.kind == "dead_lane":
+                        self._quarantine_lane(lane, "failed health check")
+                        continue
+                req.lane = lane
+            if req.lane is None:  # no healthy free lane left this tick
+                break
 
     # -- prefill mesh + handoff --------------------------------------------
 
@@ -312,7 +352,15 @@ class DisaggServingEngine(ServingEngine):
         """Run the chunk on the request's lane (prefill mesh), then stream
         the committed page to the decode placement. The device_put is
         async: the copy overlaps the lane's next chunk, and the insert is
-        deferred behind the handoff queue until it has had time to land."""
+        deferred behind the handoff queue until it has had time to land.
+
+        A lane-less request (degraded mode — every lane quarantined) routes
+        to the base colocated dispatch: same prefill program on the decode
+        placement, writing the decode-side cache directly. No handoff, no
+        arm; the decode step and its ONE executable never notice."""
+        if req.lane is None:
+            return super()._prefill_dispatch(req, chunk, valid, is_first,
+                                             is_final)
         lane = req.lane
         dc = self.disagg_config
         start = req.consumed  # host-tracked — lane slot 0 IS this request
@@ -332,7 +380,7 @@ class DisaggServingEngine(ServingEngine):
             # the clock starts at transfer dispatch, not at lane compute.
             jax.block_until_ready(pages)
             t0 = time.perf_counter()
-        pages_d = jax.device_put(pages, self._decode_sharding)
+        pages_d, delay_ticks = self._handoff_put(req, lane, pages)
         nbytes = int(pages[0].nbytes + pages[1].nbytes)
         self._hstats["bytes"] += nbytes
 
@@ -347,6 +395,7 @@ class DisaggServingEngine(ServingEngine):
         self._handoffs.append(_Handoff(
             slot=req.slot, start=start, valid=int(valid), pages=pages_d,
             nbytes=nbytes, arm=arm, budget=int(req.budget), t0=t0,
+            ready_tick=self._stats["ticks"] + delay_ticks,
         ))
         if is_final:
             # Flush before decode can observe the slot, and release the
@@ -361,15 +410,128 @@ class DisaggServingEngine(ServingEngine):
                 self._drain_one()
         return tok, done0
 
+    def _handoff_put(self, req, lane: _Lane, pages) -> tuple:
+        """The guarded transfer: one chaos draw at ``handoff_device_put``,
+        then the device_put with up to ``handoff_retries`` capped
+        jitter-backoff retries. A transient injected transfer error
+        (``fault.u < 0.75``) fails exactly one attempt; a persistent one (or
+        a real failure that survives every retry) quarantines the lane and
+        re-raises — the base recovery path then re-queues the request for
+        an idempotent re-prefill. Returns ``(pages_on_decode,
+        delay_ticks)`` where ``delay_ticks`` models a straggler transfer."""
+        dc = self.disagg_config
+        fault = None
+        if self.chaos is not None:
+            fault = self.chaos.draw("handoff_device_put",
+                                    self._stats["ticks"], unit=req.id)
+        delay_ticks = 0
+        if fault is not None and fault.kind == "delay":
+            self._fstats["handoff_delays"] += 1
+            delay_ticks = int(self.chaos.delay_ticks)
+            fault = None
+        poison = fault is not None and fault.kind == "poison"
+        attempts = int(dc.handoff_retries) + 1
+        for attempt in range(attempts):
+            try:
+                if (fault is not None and fault.kind == "transfer_error"
+                        and (attempt == 0 or fault.u >= 0.75)):
+                    raise InjectedFaultError(fault)
+                pages_d = jax.device_put(pages, self._decode_sharding)
+                break
+            except RuntimeError as e:
+                if attempt == attempts - 1:
+                    self._quarantine_lane(
+                        lane, f"handoff failed {attempts}x: {e}")
+                    raise
+                self._fstats["handoff_retries"] += 1
+                backoff = min(
+                    float(dc.handoff_backoff_s) * (2 ** attempt),
+                    float(dc.handoff_backoff_cap_s),
+                ) * deterministic_jitter(
+                    self.chaos.seed if self.chaos is not None else 0,
+                    self._stats["ticks"], attempt,
+                )
+                if backoff > 0:
+                    time.sleep(backoff)
+        if poison and jnp.issubdtype(pages[0].dtype, jnp.floating):
+            # Poisoned page: what lands on the decode mesh is all-NaN. The
+            # decode-side nonfinite-logits sentinel must catch it once the
+            # slot arms — pinned by tests and the chaos smoke.
+            pages_d = jax.device_put(
+                (jnp.full_like(pages[0], jnp.nan),
+                 jnp.full_like(pages[1], jnp.nan)),
+                self._decode_sharding,
+            )
+        return pages_d, delay_ticks
+
     def _drain_handoffs(self, drain_all: bool = False) -> None:
         if drain_all:
             while self._handoffs:
                 self._drain_one()
         else:
             # Pages queued on earlier ticks have had >= 1 tick of transfer
-            # time; keep at most the configured double buffer in flight.
-            while len(self._handoffs) > self.disagg_config.handoff_depth:
+            # time; keep at most the configured double buffer in flight. A
+            # straggler head (ready_tick in the future) blocks background
+            # draining — FIFO order is what keeps per-slot lengths
+            # monotone — until a forced drain awaits it.
+            while (len(self._handoffs) > self.disagg_config.handoff_depth
+                   and self._handoffs[0].ready_tick <= self._stats["ticks"]):
                 self._drain_one()
+
+    def _purge_slot(self, slot: int) -> None:
+        """Drop every in-flight handoff targeting ``slot`` (its request was
+        evicted or is being retried) so a stale page can never land in the
+        slot's next grant."""
+        keep = deque(h for h in self._handoffs if h.slot != slot)
+        dropped = len(self._handoffs) - len(keep)
+        if dropped:
+            self._handoffs = keep
+            if _log_ok():
+                logger.warning(
+                    "disagg: purged %d in-flight handoff page(s) for slot %d",
+                    dropped, slot,
+                )
+
+    def _release_lane(self, req, failed: bool = False) -> None:
+        """Return the request's lane to the free pool — unless it was
+        quarantined by the failure that got us here, in which case it stays
+        out of rotation."""
+        lane, req.lane = req.lane, None
+        if lane is None or lane.index in self._quarantined_lanes:
+            return
+        self._free_lanes.append(lane)
+
+    def _quarantine_lane(self, lane: _Lane, reason: str) -> None:
+        if lane.index in self._quarantined_lanes:
+            return
+        self._quarantined_lanes.add(lane.index)
+        self._fstats["lane_quarantines"] += 1
+        try:
+            self._free_lanes.remove(lane)
+        except ValueError:
+            pass  # held by a request; _release_lane won't re-pool it
+        healthy = len(self._lanes) - len(self._quarantined_lanes)
+        if _log_ok():
+            logger.warning(
+                "disagg: quarantined prefill lane %d on %s (%s); %d/%d "
+                "lane(s) remain", lane.index, lane.device, reason, healthy,
+                len(self._lanes),
+            )
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                "serving_lane_quarantined", lane=lane.index, reason=reason,
+            )
+        if healthy == 0 and not self._degraded:
+            self._degraded = True
+            if _log_ok():
+                logger.warning_once(
+                    "disagg: every prefill lane is quarantined — degrading "
+                    "to colocated prefill on the decode mesh (correct but "
+                    "slower; p95 TTFT will rise). Restart the engine to "
+                    "restore the prefill slice."
+                )
+            if self.telemetry is not None:
+                self.telemetry.record_event("serving_degraded")
 
     def _drain_one(self) -> None:
         h = self._handoffs.popleft()
@@ -444,6 +606,9 @@ class DisaggServingEngine(ServingEngine):
             "handoff_lat_mean_s": float(lat.mean()) if lat.size else None,
             "handoff_lat_p95_s": (
                 float(np.percentile(lat, 95)) if lat.size else None),
+            "quarantined_lanes": sorted(self._quarantined_lanes),
+            "healthy_lanes": len(self._lanes) - len(self._quarantined_lanes),
+            "degraded": bool(self._degraded),
             # The ratio to feed back into DisaggConfig for the next run —
             # the calibration loop the planner's cost model expects.
             "measured_flop_ratio": (

@@ -20,8 +20,9 @@ vLLM/TGI-class fix, TPU-shaped:
   possible prompt length compiles at most ``len(ladder)`` prefill
   executables.
 - **Zero-recompile decode** — the steady-state decode step is ONE jitted
-  ``(params, cache, slot_state) -> (cache, slot_state, tokens)`` program
-  with donated cache buffers; its executable count is watched every tick
+  ``(params, cache, slot_state) -> (cache, slot_state, tokens, bad)``
+  program with donated cache buffers (``bad`` is the nonfinite-logits
+  sentinel below); its executable count is watched every tick
   (``stats()["steady_recompiles"]``, cross-checked by the telemetry
   recompile watchdog when a recorder is attached).
 
@@ -29,6 +30,36 @@ Greedy decoding through the engine is token-for-token identical to
 :func:`generate` per request (tests/test_serving.py pins it); sampled
 decoding uses one PRNG stream per request (the ``rng`` passed at
 ``submit``), mirroring a batch-1 ``generate`` call.
+
+Request-lifecycle robustness (the serving twin of fault_tolerance.py's
+training-side treatment — fails loudly, degrades gracefully, verified by
+``make chaos-smoke``):
+
+- **Explicit terminal statuses** — every submitted request finishes with a
+  ``status`` in its ``poll()`` result: ``ok`` (delivered), ``timeout``
+  (missed its deadline — the slot is freed the same tick), ``shed``
+  (dropped by admission control or a preemption drain), or ``failed``
+  (recovery retries exhausted). Nothing disappears silently.
+- **Admission control + SLOs** — ``ServingConfig.max_queue_depth`` bounds
+  the queue with an ``overload_policy`` (``reject`` | ``shed_oldest`` |
+  ``block``); ``deadline_s`` (engine default or per-``submit``) is checked
+  every tick.
+- **Nonfinite-logits sentinel** — the decode step reports per-slot
+  nonfinite logits alongside the sampled tokens (one fused fetch — no
+  extra dispatch stall, the serving analog of PR 3's lagged divergence
+  sentinel). A poisoned slot is quarantined and its request retried
+  (bounded by ``max_retries``) with an idempotent, bit-equal resubmission.
+- **Hang guard** — ``max_idle_ticks`` ticks with pending requests but zero
+  progress raise :class:`ServingStalledError` naming the stuck requests
+  instead of spinning forever.
+- **Preemption drain** — with a fault-tolerance manager attached
+  (``fault_tolerance=`` or via ``Accelerator.build_serving_engine``),
+  SIGTERM finishes in-flight requests, sheds the queue, and the engine
+  reports :data:`~accelerate_tpu.utils.constants.PREEMPTION_EXIT_CODE`
+  (75) for a resumable exit instead of dying mid-flight.
+- **Deterministic fault injection** — pass a
+  :class:`~accelerate_tpu.chaos.FaultInjector` (``chaos=``) to exercise
+  every one of these paths on a seed-replayable schedule.
 
 Off by default everywhere: no engine exists unless you construct one (or
 pass a :class:`~accelerate_tpu.utils.ServingConfig` to
@@ -61,6 +92,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .chaos import InjectedFaultError
 from .generation import (
     ENCDEC_GENERATION_PLANS,
     GENERATION_PLANS,
@@ -70,8 +102,29 @@ from .generation import (
     sample_logits,
 )
 from .logging import get_logger
+from .utils.constants import PREEMPTION_EXIT_CODE
 
 logger = get_logger(__name__)
+
+
+def _log_ok() -> bool:
+    """The repo logger needs accelerate state; the engine must also work
+    standalone (no Accelerator), where these logs are just skipped."""
+    from .state import PartialState
+
+    return bool(PartialState._shared_state)
+
+
+#: The explicit terminal statuses every request ends with (poll() results).
+REQUEST_STATUSES = ("ok", "timeout", "shed", "failed")
+
+
+class ServingStalledError(RuntimeError):
+    """The engine made no progress for ``max_idle_ticks`` consecutive ticks
+    while requests were still pending — e.g. every lane wedged or every
+    slot quarantined. Raised from ``tick()`` (so ``run()`` and
+    :func:`replay_trace` fail loudly instead of spinning), naming the stuck
+    requests and their states."""
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +218,11 @@ def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
             )[0]
         )(logits, sub)
         tok = jnp.where(live, tok, state.last_token)
+        # Nonfinite-logits sentinel: flag live rows whose logits went NaN/inf
+        # (a poisoned KV page). Computed on the PRE-update live mask so parked
+        # rows' masked garbage never flags, and fetched with the same host
+        # sync as (tok, done) — no extra dispatch stall.
+        bad = live & ~jnp.isfinite(logits).all(axis=-1)
         generated = state.generated + live.astype(jnp.int32)
         newly_done = live & (generated >= state.budget)
         if eos_token_id is not None:
@@ -179,7 +237,7 @@ def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
             # so advancing every row keeps the update shape-uniform.
             rng=carry,
         )
-        return KVCache(new_cache.k, new_cache.v, lengths), new_state, tok
+        return KVCache(new_cache.k, new_cache.v, lengths), new_state, tok, bad
 
     return jax.jit(decode, donate_argnums=(1, 2))
 
@@ -231,6 +289,24 @@ def _build_prefill_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
     return jax.jit(prefill, donate_argnums=(1, 2))
 
 
+def _release_slot_op(state: SlotState, slot) -> SlotState:
+    """Mark one device slot done mid-flight (timeout eviction, quarantine):
+    ``live = active & ~done`` goes False so the decode step computes masked
+    garbage for the row until a new grant's first prefill chunk rewrites it.
+    A separate tiny program — the ONE-decode-executable census is untouched."""
+    return SlotState(
+        last_token=state.last_token,
+        active=state.active,
+        done=state.done.at[slot].set(True),
+        generated=state.generated,
+        budget=state.budget,
+        rng=state.rng,
+    )
+
+
+_release_step = jax.jit(_release_slot_op, donate_argnums=(0,))
+
+
 def _cache_size(fn) -> Optional[int]:
     size_fn = getattr(fn, "_cache_size", None)
     if callable(size_fn):
@@ -250,6 +326,7 @@ class _Request:
     __slots__ = (
         "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
         "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
+        "deadline", "retries", "status",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -267,6 +344,22 @@ class _Request:
         self.admit_t = None           # slot granted (TTFT = queue + prefill)
         self.first_token_t = None
         self.done_t = None
+        self.deadline = None          # absolute perf_counter SLO, or None
+        self.retries = 0              # recovery resubmissions consumed
+        self.status = None            # terminal: ok | timeout | shed | failed
+
+    def reset_for_retry(self) -> None:
+        """Back to freshly-queued: prompt, budget, rng, deadline, and the
+        original submit_t survive, so the resubmission is idempotent — the
+        same per-request PRNG stream replays bit-equal output."""
+        self.slot = None
+        self.lane = None
+        self.chunks = None
+        self.next_chunk = 0
+        self.consumed = 0
+        self.out = []
+        self.admit_t = None
+        self.first_token_t = None
 
 
 class ServingEngine:
@@ -280,15 +373,25 @@ class ServingEngine:
     ``compile_manager`` to source the prefill ladder from its seq-bucket
     policy, and ``telemetry`` to stream per-request TTFT/TPOT events and the
     serving summary into the PR-1 recorder.
+
+    Robustness knobs: ``fault_tolerance`` (a
+    :class:`~accelerate_tpu.fault_tolerance.FaultToleranceManager`) arms the
+    preemption drain; ``chaos`` (a
+    :class:`~accelerate_tpu.chaos.FaultInjector`) arms deterministic fault
+    injection. Both default to None — the hot path then holds one ``is
+    None`` check per site.
     """
 
     def __init__(self, model, config=None, *, forward_cached: Optional[Callable] = None,
-                 compile_manager=None, telemetry=None):
+                 compile_manager=None, telemetry=None, fault_tolerance=None,
+                 chaos=None):
         from .utils.dataclasses import ServingConfig
 
         self.config = config if config is not None else ServingConfig()
         self.model = model
         self.telemetry = telemetry
+        self.fault_tolerance = fault_tolerance
+        self.chaos = chaos
         name = type(model.module).__name__
         if forward_cached is not None:
             fwd = forward_cached
@@ -370,14 +473,38 @@ class ServingEngine:
             "peak_occupancy": 0, "queue_depth_sum": 0, "queue_samples": 0,
             "steady_recompiles": 0,
         }
+        # Robustness state: fault counters (the telemetry "faults" block),
+        # quarantined slots (poisoned rows taken out of rotation), the
+        # preemption-drain latch, and the hang-guard idle counter.
+        self._fstats = {
+            "sheds": 0, "timeouts": 0, "failed": 0, "retries": 0,
+            "slot_quarantines": 0, "lane_quarantines": 0,
+            "handoff_retries": 0, "handoff_delays": 0,
+        }
+        self._quarantined_slots: set[int] = set()
+        self._poison_op = None       # lazily jitted chaos-only program
+        self._draining = False
+        self._idle_ticks = 0
+        self._has_deadlines = self.config.deadline_s is not None
 
     # -- request lifecycle -------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               rng: Optional[jax.Array] = None) -> int:
+               rng: Optional[jax.Array] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D token id
         sequence; ``rng`` seeds this request's private sampling stream
-        (default ``jax.random.key(0)`` — generate()'s default)."""
+        (default ``jax.random.key(0)`` — generate()'s default);
+        ``deadline_s`` overrides ``ServingConfig.deadline_s`` for this
+        request (seconds from submission — miss it and the request finishes
+        ``timeout``).
+
+        Admission control: with ``max_queue_depth`` set and the queue full,
+        ``overload_policy`` decides — ``reject`` finishes THIS request
+        ``shed`` immediately, ``shed_oldest`` drops the oldest queued
+        request instead, ``block`` ticks the engine until a queue slot
+        frees (bounded by the hang guard). Every path still returns an id
+        whose result lands in ``poll()``."""
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("empty prompt")
@@ -393,17 +520,44 @@ class ServingEngine:
             )
         req = _Request(next(self._ids), tokens, budget,
                        rng if rng is not None else jax.random.key(0))
-        self._queue.append(req)
+        dl = deadline_s if deadline_s is not None else self.config.deadline_s
+        if dl is not None:
+            if float(dl) <= 0:
+                raise ValueError(f"deadline_s must be > 0, got {dl}")
+            req.deadline = req.submit_t + float(dl)
+            self._has_deadlines = True
         self._stats["submitted"] += 1
         if self._first_submit_t is None:
             self._first_submit_t = req.submit_t
+        if self._draining:  # preemption drain: nothing new gets in
+            self._finish(req, "shed")
+            return req.id
+        cap = self.config.max_queue_depth
+        if cap is not None and len(self._queue) >= cap:
+            policy = self.config.overload_policy
+            if policy == "reject":
+                self._finish(req, "shed")
+                return req.id
+            if policy == "shed_oldest":
+                self._finish(self._queue.popleft(), "shed")
+            else:  # block: apply backpressure by running the engine
+                while len(self._queue) >= cap and not self._draining:
+                    self.tick()
+                if self._draining:
+                    self._finish(req, "shed")
+                    return req.id
+        self._queue.append(req)
         return req.id
 
     def poll(self) -> list[dict]:
-        """Results finished since the last poll: ``{"id", "tokens",
-        "new_tokens", "ttft_s", "tpot_s"}`` — ``tokens`` is the full
-        prompt+continuation row padded to ``prompt+budget`` with
-        ``pad_token_id`` (generate()'s row layout)."""
+        """Results finished since the last poll: ``{"id", "status",
+        "tokens", "new_tokens", "ttft_s", "tpot_s"}`` — ``tokens`` is the
+        full prompt+continuation row padded to ``prompt+budget`` with
+        ``pad_token_id`` (generate()'s row layout). ``status`` is the
+        request's explicit terminal state, one of
+        :data:`REQUEST_STATUSES` (``ok`` | ``timeout`` | ``shed`` |
+        ``failed``) — EVERY submitted id eventually shows up here with
+        one."""
         out = list(self._finished)
         self._finished.clear()
         return out
@@ -416,9 +570,13 @@ class ServingEngine:
     # -- the tick ----------------------------------------------------------
 
     def tick(self) -> None:
-        """One scheduler round: admit into free slots, advance one prompt
-        chunk (up to ``prefill_chunks_per_tick``), then one decode step for
-        every live slot."""
+        """One scheduler round: sweep deadlines (and the preemption latch),
+        admit into free slots, advance one prompt chunk (up to
+        ``prefill_chunks_per_tick``), then one decode step for every live
+        slot. Raises :class:`ServingStalledError` via the hang guard if
+        ``max_idle_ticks`` rounds pass with pending requests and zero
+        progress."""
+        snap = self._begin_tick()
         self._admit()
         self._stats["queue_depth_sum"] += len(self._queue)
         self._stats["queue_samples"] += 1
@@ -428,7 +586,79 @@ class ServingEngine:
             self._prefill_one(self._prefilling[0])
         if self._decoding:
             self._decode_tick()
+        self._end_tick(snap)
+
+    # -- robustness plumbing (shared with the disagg router's tick) --------
+
+    def _progress_marker(self) -> tuple:
+        """Anything that changes when the engine moves: admissions, prefill
+        chunks, decode steps, terminal results. Equal across a tick with
+        requests pending == an idle tick (the hang-guard's definition)."""
+        s = self._stats
+        return (s["slot_allocs"], s["prefill_chunks"], s["decode_steps"],
+                s["completed"], self._fstats["sheds"],
+                self._fstats["timeouts"], self._fstats["failed"])
+
+    def _begin_tick(self) -> tuple:
+        ft = self.fault_tolerance
+        if not self._draining and ft is not None and getattr(ft, "preempted", False):
+            self._draining = True
+            if _log_ok():
+                logger.warning(
+                    "serving: preemption signal — shedding %d queued "
+                    "request(s), draining %d in flight, then exiting "
+                    "resumable (code %d)",
+                    len(self._queue),
+                    len(self._prefilling) + len(self._decoding),
+                    PREEMPTION_EXIT_CODE,
+                )
+            while self._queue:
+                self._finish(self._queue.popleft(), "shed")
+        if self._has_deadlines:
+            self._expire_deadlines()
+        return self._progress_marker()
+
+    def _end_tick(self, snap: tuple) -> None:
         self._stats["ticks"] += 1
+        if self.pending and self._progress_marker() == snap:
+            self._idle_ticks += 1
+            if self._idle_ticks >= int(self.config.max_idle_ticks):
+                states = (
+                    [f"{r.id}:queued" for r in self._queue]
+                    + [f"{r.id}:prefilling(chunk {r.next_chunk}/{len(r.chunks or [])})"
+                       for r in self._prefilling]
+                    + [f"{r.id}:decoding(slot {s})"
+                       for s, r in sorted(self._decoding.items())]
+                )
+                raise ServingStalledError(
+                    f"serving engine made no progress for {self._idle_ticks} "
+                    f"consecutive ticks with {self.pending} request(s) "
+                    f"pending [{', '.join(states)}] — "
+                    f"{len(self._quarantined_slots)}/{self.n_slots} slots "
+                    "quarantined; see docs/troubleshooting.md"
+                )
+        else:
+            self._idle_ticks = 0
+
+    def _expire_deadlines(self) -> None:
+        now = time.perf_counter()
+        stale = [r for r in list(self._queue) + list(self._prefilling)
+                 + list(self._decoding.values())
+                 if r.deadline is not None and now >= r.deadline]
+        for req in stale:
+            self._evict(req, "timeout")
+
+    @property
+    def preempted(self) -> bool:
+        """True once the preemption drain latched (the fault-tolerance
+        manager saw SIGTERM); queued work is shed and nothing new admits."""
+        return self._draining
+
+    @property
+    def preemption_exit_code(self) -> int:
+        """The resumable exit code (75) a serving front-end should exit
+        with after a preempted drain — the launch gang restarts it."""
+        return PREEMPTION_EXIT_CODE
 
     def _grant(self, req: _Request, slot: int) -> None:
         """Grant ``slot`` to ``req`` and move it onto the prefill queue —
@@ -456,7 +686,19 @@ class ServingEngine:
         chunk[0, :valid] = req.tokens[req.consumed:req.consumed + valid]
         is_first = req.next_chunk == 0
         is_final = req.next_chunk == len(req.chunks) - 1
-        tok, done0 = self._prefill_dispatch(req, chunk, valid, is_first, is_final)
+        try:
+            if self.chaos is not None:
+                fault = self.chaos.draw("prefill_dispatch",
+                                        self._stats["ticks"], unit=req.id)
+                if fault is not None:
+                    raise InjectedFaultError(fault)
+            tok, done0 = self._prefill_dispatch(req, chunk, valid, is_first,
+                                                is_final)
+        except RuntimeError as e:
+            # InjectedFaultError or a real XLA runtime failure — recovery is
+            # identical. Programming errors (TypeError etc.) still propagate.
+            self._on_prefill_failure(req, e)
+            return
         req.next_chunk += 1
         req.consumed += valid
         self._stats["prefill_chunks"] += 1
@@ -483,7 +725,11 @@ class ServingEngine:
         return tok, done0
 
     def _decode_tick(self) -> None:
-        self._cache, self._state, tok = self._decode(
+        if self.chaos is not None and self._decoding:
+            fault = self.chaos.draw("decode_tick", self._stats["ticks"])
+            if fault is not None and fault.kind == "poison":
+                self._poison_slot(min(self._decoding))
+        self._cache, self._state, tok, bad = self._decode(
             self._params, self._cache, self._state
         )
         live = len(self._decoding)
@@ -511,44 +757,182 @@ class ServingEngine:
                     "executable(s)) — the steady state should be exactly one "
                     "program; see docs/usage_guides/serving.md.", extra,
                 )
-        # The per-tick host sync: fetch this round's tokens + done flags.
-        tok_np, done_np = jax.device_get((tok, self._state.done))
+        # The per-tick host sync: fetch this round's tokens + done flags +
+        # the nonfinite sentinel (one fused device_get — no extra stall).
+        tok_np, done_np, bad_np = jax.device_get((tok, self._state.done, bad))
         for slot, req in list(self._decoding.items()):
+            if bool(bad_np[slot]):
+                self._on_poisoned_slot(slot, req)
+                continue
             req.out.append(int(tok_np[slot]))
             if bool(done_np[slot]):
                 del self._decoding[slot]
                 self._retire(req)
 
     def _retire(self, req: _Request) -> None:
+        """Natural completion: the device row already flagged itself done, so
+        the slot goes straight back to the free list."""
+        self._free.append(req.slot)
+        self._finish(req, "ok")
+
+    def _finish(self, req: _Request, status: str) -> None:
+        """The single terminal gate: EVERY submitted request exits through
+        here exactly once, with an explicit status."""
+        req.status = status
         req.done_t = time.perf_counter()
         self._last_done_t = req.done_t
-        self._free.append(req.slot)
         n_new = len(req.out)
         row = np.concatenate([
             req.tokens,
             np.asarray(req.out, np.int32),
             np.full((req.budget - n_new,), self.pad_token_id, np.int32),
         ])
-        ttft = req.first_token_t - req.submit_t
-        tpot = ((req.done_t - req.first_token_t) / (n_new - 1)) if n_new > 1 else 0.0
-        self._ttfts.append(ttft)
-        self._tpots.append(tpot)
-        if req.admit_t is not None:
-            self._queue_waits.append(req.admit_t - req.submit_t)
-            self._prefill_lats.append(req.first_token_t - req.admit_t)
-        self._stats["completed"] += 1
-        self._stats["tokens_out"] += n_new
-        self._stats["prompt_tokens_in"] += int(req.tokens.size)
+        ttft = (req.first_token_t - req.submit_t
+                if req.first_token_t is not None else None)
+        tpot = ((req.done_t - req.first_token_t) / (n_new - 1)
+                if req.first_token_t is not None and n_new > 1 else 0.0)
+        if status == "ok":
+            self._ttfts.append(ttft)
+            self._tpots.append(tpot)
+            if req.admit_t is not None:
+                self._queue_waits.append(req.admit_t - req.submit_t)
+                self._prefill_lats.append(req.first_token_t - req.admit_t)
+            # Throughput/latency aggregates stay ok-only, so a shed storm
+            # can't flatter (or taint) the SLO numbers.
+            self._stats["completed"] += 1
+            self._stats["tokens_out"] += n_new
+            self._stats["prompt_tokens_in"] += int(req.tokens.size)
+        else:
+            self._fstats[{"timeout": "timeouts", "shed": "sheds",
+                          "failed": "failed"}[status]] += 1
         self._finished.append({
-            "id": req.id, "tokens": row, "new_tokens": n_new,
+            "id": req.id, "status": status, "tokens": row, "new_tokens": n_new,
             "ttft_s": ttft, "tpot_s": tpot,
         })
         if self.telemetry is not None:
             self.telemetry.record_event(
-                "serving_request_done", request_id=req.id, ttft_s=ttft,
-                tpot_s=tpot, new_tokens=n_new,
+                "serving_request_done", request_id=req.id, status=status,
+                ttft_s=ttft, tpot_s=tpot, new_tokens=n_new,
                 prompt_tokens=int(req.tokens.size), slot=req.slot,
             )
+            if status != "ok":
+                self.telemetry.record_event(
+                    "serving_fault", request_id=req.id, status=status,
+                    retries=req.retries,
+                )
+
+    # -- failure recovery --------------------------------------------------
+
+    def _evict(self, req: _Request, status: str) -> None:
+        """Terminate an in-flight request (deadline miss, shed): pull it out
+        of whichever stage holds it, free its lane/slot IMMEDIATELY (the
+        device row is killed so the next decode step masks it), finish with
+        ``status``."""
+        if req in self._queue:
+            self._queue.remove(req)
+        elif req in self._prefilling:
+            self._prefilling.remove(req)
+        elif req.slot is not None and self._decoding.get(req.slot) is req:
+            del self._decoding[req.slot]
+        self._release_lane(req)
+        if req.slot is not None:
+            self._purge_slot(req.slot)
+            self._release_slot(req.slot)
+        self._finish(req, status)
+
+    def _release_slot(self, slot: int) -> None:
+        """Free a slot whose occupant left mid-flight: mark the device row
+        done (so decode masks it) and return it to the pool."""
+        self._state = _release_step(self._state, np.int32(slot))
+        self._free.append(slot)
+
+    def _release_lane(self, req: _Request, failed: bool = False) -> None:
+        """Disagg-router hook: return (or quarantine) ``req``'s prefill
+        lane. Colocated engines have no lanes — no-op."""
+
+    def _purge_slot(self, slot: int) -> None:
+        """Disagg-router hook: drop any in-flight KV-page handoffs targeting
+        ``slot`` so a stale page can never land in the next grant. Colocated
+        engines stream nothing — no-op."""
+
+    def _retry_or_fail(self, req: _Request, reason: str = "") -> None:
+        """Idempotent recovery resubmission: reset the request to
+        freshly-queued (same prompt, budget, rng → bit-equal replay) and
+        put it at the HEAD of the queue, or finish ``failed`` once
+        ``max_retries`` is spent."""
+        if self._draining or req.retries >= int(self.config.max_retries):
+            if _log_ok():
+                logger.warning(
+                    "serving: request %d failed permanently after %d retr%s%s",
+                    req.id, req.retries, "y" if req.retries == 1 else "ies",
+                    f" ({reason})" if reason else "",
+                )
+            self._finish(req, "failed")
+            return
+        req.retries += 1
+        self._fstats["retries"] += 1
+        req.reset_for_retry()
+        self._queue.appendleft(req)
+
+    def _on_prefill_failure(self, req: _Request, exc: Exception) -> None:
+        """A prefill chunk dispatch (or disagg handoff) failed after its own
+        local retries: free everything the request held, then resubmit or
+        fail it."""
+        if _log_ok():
+            logger.warning("serving: prefill failed for request %d: %s",
+                           req.id, exc)
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        self._release_lane(req, failed=True)
+        if req.slot is not None:
+            self._purge_slot(req.slot)
+            self._release_slot(req.slot)
+            req.slot = None
+        self._retry_or_fail(req, reason=str(exc))
+
+    def _on_poisoned_slot(self, slot: int, req: _Request) -> None:
+        """The decode sentinel flagged nonfinite logits in ``slot``: its KV
+        page is corrupt, so the slot leaves rotation for good and the
+        request replays from scratch elsewhere."""
+        del self._decoding[slot]
+        self._quarantine_slot(slot)
+        req.slot = None
+        self._retry_or_fail(req, reason=f"nonfinite logits in slot {slot}")
+
+    def _quarantine_slot(self, slot: int) -> None:
+        self._quarantined_slots.add(slot)
+        self._fstats["slot_quarantines"] += 1
+        self._state = _release_step(self._state, np.int32(slot))
+        if _log_ok():
+            logger.warning(
+                "serving: quarantined slot %d (nonfinite logits — poisoned "
+                "KV page); %d/%d slots remain", slot,
+                self.n_slots - len(self._quarantined_slots), self.n_slots,
+            )
+        if self.telemetry is not None:
+            self.telemetry.record_event("serving_slot_quarantined", slot=slot)
+
+    def _poison_slot(self, slot: int) -> None:
+        """Chaos-only: overwrite ``slot``'s KV page with NaN so the decode
+        sentinel must catch it. A separate lazily-jitted program — never
+        compiled unless a poison fault actually fires, so the decode
+        executable census is untouched."""
+        if not jnp.issubdtype(self._cache.k.dtype, jnp.floating):
+            if _log_ok():
+                logger.warning_once(
+                    "serving: poison fault skipped — cache dtype "
+                    f"{self._cache.k.dtype} has no NaN"
+                )
+            return
+        if self._poison_op is None:
+            def poison(cache: KVCache, slot):
+                return KVCache(
+                    cache.k.at[:, slot].set(jnp.nan),
+                    cache.v.at[:, slot].set(jnp.nan),
+                    cache.length,
+                )
+            self._poison_op = jax.jit(poison, donate_argnums=(0,))
+        self._cache = self._poison_op(self._cache, np.int32(slot))
 
     # -- batch front-end ---------------------------------------------------
 
@@ -604,6 +988,9 @@ class ServingEngine:
         compiled programs — the boundary between warmup and measurement."""
         for k in self._stats:
             self._stats[k] = 0
+        for k in self._fstats:
+            self._fstats[k] = 0
+        self._idle_ticks = 0
         self._decode_executables_baseline = None
         self._first_submit_t = None
         self._last_done_t = None
@@ -675,8 +1062,20 @@ class ServingEngine:
             "steady_recompiles": s["steady_recompiles"],
             "decode_executables": execs["decode"],
             "prefill_executables": execs["prefill"],
+            "faults": self.fault_stats(),
         }
         return out
+
+    def fault_stats(self) -> dict:
+        """The ``faults`` telemetry block: terminal-status counters plus the
+        recovery/degradation state (bench rows and ``make chaos-smoke``
+        embed this verbatim)."""
+        f = dict(self._fstats)
+        f["injected"] = len(self.chaos.injected) if self.chaos is not None else 0
+        f["quarantined_slots"] = len(self._quarantined_slots)
+        f["degraded"] = bool(getattr(self, "_degraded", False))
+        f["preempted"] = bool(self._draining)
+        return f
 
     def _push_telemetry_summary(self) -> None:
         if self.telemetry is not None:

@@ -571,6 +571,32 @@ class ServingConfig(KwargsHandler):
     - ``cache_dtype``: KV-cache dtype override (default: model dtype).
     - ``seed``: seeds the idle slots' PRNG pool; each request's stream is
       the ``rng`` passed at ``submit`` (default ``jax.random.key(0)``).
+
+    Admission control + SLOs (every request terminates with an explicit
+    ``status`` in ``poll()`` results — ``ok | timeout | shed | failed``;
+    see docs/usage_guides/serving.md "Serving under faults"):
+
+    - ``max_queue_depth``: bound on the admission queue; ``None`` (default)
+      keeps the unbounded pre-SLO behavior. When the bound is hit,
+      ``overload_policy`` decides: ``"reject"`` sheds the NEW request
+      immediately (status ``shed``), ``"shed_oldest"`` drops the oldest
+      queued request to make room, ``"block"`` ticks the engine inside
+      ``submit()`` until a queue slot frees (the hang guard still bounds a
+      wedged engine).
+    - ``deadline_s``: default per-request deadline, measured from
+      ``submit()`` (override per request). Deadline checks run every tick;
+      a timed-out request frees its slot immediately and finishes with
+      status ``timeout``.
+    - ``max_retries``: per-request recovery budget — how many times a
+      request may be re-queued after a fault (poisoned slot, failed
+      handoff, dead lane) before it finishes with status ``failed``.
+      Resubmission is idempotent: the prompt + rng payload make the retry
+      bit-equal to a fresh submit.
+    - ``max_idle_ticks``: hang guard — after this many consecutive ticks
+      with pending requests but no admission, prefill progress, live
+      decode, or retirement, the engine raises
+      :class:`~accelerate_tpu.serving.ServingStalledError` naming the stuck
+      requests instead of spinning forever.
     """
 
     enabled: bool = True
@@ -588,6 +614,11 @@ class ServingConfig(KwargsHandler):
     pad_token_id: Optional[int] = None
     cache_dtype: Any = None
     seed: int = 0
+    max_queue_depth: Optional[int] = None
+    overload_policy: str = "reject"
+    deadline_s: Optional[float] = None
+    max_retries: int = 2
+    max_idle_ticks: int = 100
 
     def __post_init__(self):
         if self.n_slots < 1:
@@ -601,6 +632,19 @@ class ServingConfig(KwargsHandler):
                 "need 1 <= min_prefill_chunk <= max_prefill_chunk, got "
                 f"{self.min_prefill_chunk}..{self.max_prefill_chunk}"
             )
+        if self.overload_policy not in ("reject", "shed_oldest", "block"):
+            raise ValueError(
+                "overload_policy must be 'reject', 'shed_oldest', or "
+                f"'block', got {self.overload_policy!r}"
+            )
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 (or None)")
+        if self.deadline_s is not None and not self.deadline_s > 0:
+            raise ValueError("deadline_s must be > 0 (or None)")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.max_idle_ticks < 1:
+            raise ValueError("max_idle_ticks must be >= 1")
 
 
 @dataclass
@@ -629,6 +673,12 @@ class DisaggConfig(KwargsHandler):
       the decode mesh before the router drains the oldest — depth 2 is the
       double-buffer that overlaps a chunk's transfer with the next chunk's
       prefill.
+    - ``handoff_retries`` / ``handoff_backoff_s`` / ``handoff_backoff_cap_s``:
+      a failed KV-page transfer retries this many times with capped,
+      deterministically-jittered exponential backoff before the engine
+      quarantines the lane and re-queues its in-flight request (bounded by
+      ``ServingConfig.max_retries``); see docs/usage_guides/serving.md
+      "Serving under faults".
     - ``handoff_sample_every``: every Nth page transfer is timed end-to-end
       (a sampled ``block_until_ready``) to feed the telemetry ``disagg``
       block's handoff latency without stalling the pipeline on every page.
@@ -652,6 +702,9 @@ class DisaggConfig(KwargsHandler):
     n_prefill_lanes: int = 2
     handoff_depth: int = 2
     handoff_sample_every: int = 8
+    handoff_retries: int = 2
+    handoff_backoff_s: float = 0.001
+    handoff_backoff_cap_s: float = 0.05
     bandwidths: Optional[dict] = None
     shard_decode_slots: bool = False
 
@@ -670,6 +723,13 @@ class DisaggConfig(KwargsHandler):
             raise ValueError("handoff_depth must be >= 1")
         if self.handoff_sample_every < 1:
             raise ValueError("handoff_sample_every must be >= 1")
+        if self.handoff_retries < 0:
+            raise ValueError("handoff_retries must be >= 0")
+        if self.handoff_backoff_s < 0 or self.handoff_backoff_cap_s < self.handoff_backoff_s:
+            raise ValueError(
+                "need 0 <= handoff_backoff_s <= handoff_backoff_cap_s, got "
+                f"{self.handoff_backoff_s}..{self.handoff_backoff_cap_s}"
+            )
 
 
 @dataclass

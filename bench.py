@@ -359,7 +359,8 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                 k: sv.get(k)
                 for k in ("requests_completed", "tokens_per_s", "ttft_p50_s",
                           "ttft_p95_s", "tpot_mean_s", "mean_occupancy",
-                          "steady_recompiles", "decode_executables")
+                          "steady_recompiles", "decode_executables",
+                          "faults")
             }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).

@@ -23,9 +23,17 @@ Three rows, one JSON line each:
   :class:`~accelerate_tpu.disagg.DisaggServingEngine` (planner-sized
   prefill/decode slices, streamed KV-page handoff) with the telemetry
   ``disagg`` block embedded in the row.
+- ``--chaos`` (implies ``--serving``) adds a ``serving_chaos`` row: the
+  same trace under a seed-driven :class:`~accelerate_tpu.chaos.FaultInjector`
+  (rate-driven handoff transfer errors + one dead lane when disaggregated,
+  a poisoned KV page always) with the ``serving.faults`` telemetry block —
+  status counts, retries, quarantines, injected-fault log size — embedded
+  in the row, so robustness overhead shows up in the perf trajectory next
+  to the fault-free rows.
 
     python benchmarks/generate_bench.py [--params-b 1] [--new-tokens 64]
-                                        [--serving] [--disagg] [--qps 8]
+                                        [--serving] [--disagg] [--chaos]
+                                        [--qps 8]
 """
 
 import argparse
@@ -79,12 +87,16 @@ def main():
                          "the same Poisson trace; implies --serving)")
     ap.add_argument("--lanes", type=int, default=4,
                     help="prefill lanes for the --disagg row")
+    ap.add_argument("--chaos", action="store_true",
+                    help="add a serving_chaos row (same trace under a "
+                         "deterministic FaultInjector; implies --serving)")
+    ap.add_argument("--chaos-seed", type=int, default=7)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--qps", type=float, default=8.0,
                     help="Poisson arrival rate for the serving rows")
     args = ap.parse_args()
-    if args.disagg:
+    if args.disagg or args.chaos:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -292,6 +304,56 @@ def main():
                 "steady_recompiles": dst["steady_recompiles"],
                 "disagg": dst["disagg"],
             }), flush=True)
+
+        # Chaos row: the same trace under a deterministic FaultInjector —
+        # the robustness overhead (retries, quarantines, degraded fallback)
+        # priced against the fault-free rows above. Disaggregated when
+        # --disagg ran (handoff faults + a dead lane); colocated otherwise
+        # (a poisoned KV page through the decode sentinel).
+        if args.chaos:
+            from accelerate_tpu import FaultInjector
+
+            use_disagg = args.disagg and len(jax.devices()) >= 2
+            rates = {"handoff_device_put": {"transfer_error": 0.05}} \
+                if use_disagg else {}
+            schedule = [{"point": "decode_tick", "kind": "poison", "tick": 25}]
+            if use_disagg:
+                schedule.append({"point": "lane_health", "kind": "dead_lane",
+                                 "unit": 0})
+            chaos = FaultInjector(seed=args.chaos_seed, rates=rates,
+                                  schedule=schedule)
+            ccfg = ServingConfig(n_slots=slots, max_len=t_cap,
+                                 max_prefill_chunk=max(16, args.prompt_len),
+                                 max_retries=3,
+                                 max_idle_ticks=max(100, 4 * t_cap))
+            if use_disagg:
+                from accelerate_tpu import DisaggConfig, DisaggServingEngine
+
+                cengine = DisaggServingEngine(
+                    res_model, ccfg,
+                    disagg=DisaggConfig(n_prefill_lanes=args.lanes))
+            else:
+                cengine = ServingEngine(res_model, ccfg)
+            cengine.warmup()   # compiles out of TTFT; the tick clock re-zeroes
+            cengine.chaos = chaos  # attach after warmup: draws stay replayable
+            _, cha_s = replay_trace(cengine, reqs, arrivals=list(arrivals),
+                                    max_new_tokens=[int(b) for b in budgets])
+            cst = cengine.stats()
+            row = {
+                "row": "serving_chaos", "seconds": round(cha_s, 3),
+                "chaos_seed": args.chaos_seed,
+                "useful_tokens": cst["tokens_out"],
+                "tokens_per_s": cst["tokens_per_s"],
+                "ttft_p50_s": round(cst["ttft_p50_s"], 4),
+                "ttft_p95_s": round(cst["ttft_p95_s"], 4),
+                "decode_executables": cst["decode_executables"],
+                "steady_recompiles": cst["steady_recompiles"],
+                "faults": cst["faults"],
+            }
+            if use_disagg:
+                row["degraded"] = cst["disagg"]["degraded"]
+                row["healthy_lanes"] = cst["disagg"]["healthy_lanes"]
+            print(json.dumps(row), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)

@@ -386,3 +386,40 @@ def test_accelerator_disagg_disabled_handler(tmp_path, llama):
     acc = _accelerator(tmp_path, [sc, DisaggConfig(enabled=False)])
     engine = acc.build_serving_engine(model)
     assert not isinstance(engine, DisaggServingEngine)
+
+
+# ---------------------------------------------------------------------------
+# Robustness surface (the full fault matrix lives in tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lane_quarantine_survives_on_remaining_lane(llama):
+    """Killing ONE of two prefill lanes quarantines it without degrading:
+    the survivor carries the whole trace, rows stay bit-equal to generate(),
+    and the decode census stays 1."""
+    from accelerate_tpu import FaultInjector, generate
+
+    cfg, model = llama
+    chaos = FaultInjector(
+        seed=3,
+        schedule=[{"point": "lane_health", "kind": "dead_lane", "unit": 0}],
+    )
+    eng = DisaggServingEngine(
+        model,
+        ServingConfig(n_slots=4, max_len=64, prefill_chunks=[4, 8]),
+        disagg=DisaggConfig(n_prefill_lanes=2),
+        chaos=chaos,
+    )
+    prompts = _prompts(cfg, [3, 7, 12, 20, 5, 9])
+    budgets = [6, 4, 8, 3, 5, 6]
+    outs = eng.run(prompts, max_new_tokens=budgets)
+    for p, b, got in zip(prompts, budgets, outs):
+        want = np.asarray(generate(model, p[None], max_new_tokens=b))[0]
+        np.testing.assert_array_equal(got, want)
+    s = eng.stats()
+    assert s["faults"]["lane_quarantines"] == 1
+    assert s["disagg"]["quarantined_lanes"] == [0]
+    assert s["disagg"]["healthy_lanes"] == 1
+    assert s["disagg"]["degraded"] is False
+    assert s["decode_executables"] == 1
+    assert s["steady_recompiles"] == 0

@@ -380,3 +380,53 @@ def test_generation_signatures_reach_manifest_and_warm(tmp_path, llama):
     for e in cm.manifest.entries:
         spec_array_dims(e["spec"], dims)
     assert dims == {"batch": set(), "seq": set()}  # ...but never warms a step
+
+
+# ---------------------------------------------------------------------------
+# Robustness surface (the full fault matrix lives in tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+
+def test_poll_rows_carry_explicit_status(llama):
+    """Every poll() row now names its terminal state; the fault-free path is
+    all `ok` and the faults stats block stays zeroed."""
+    from accelerate_tpu.serving import REQUEST_STATUSES
+
+    cfg, model = llama
+    eng = ServingEngine(
+        model, ServingConfig(n_slots=2, max_len=64, prefill_chunks=[4, 8])
+    )
+    ids = [eng.submit(p, max_new_tokens=3) for p in _prompts(cfg, [5, 9])]
+    rows = {}
+    while eng.pending:
+        eng.tick()
+        for r in eng.poll():
+            rows[r["id"]] = r
+    assert set(rows) == set(ids)
+    for r in rows.values():
+        assert r["status"] == "ok"
+        assert r["status"] in REQUEST_STATUSES
+    f = eng.stats()["faults"]
+    assert f["injected"] == 0 and f["sheds"] == 0 and f["timeouts"] == 0
+
+
+def test_submit_deadline_validation(llama):
+    cfg, model = llama
+    eng = ServingEngine(
+        model, ServingConfig(n_slots=1, max_len=64, prefill_chunks=[4, 8])
+    )
+    with pytest.raises(ValueError):
+        eng.submit(_prompts(cfg, [5])[0], max_new_tokens=2, deadline_s=0.0)
+    with pytest.raises(ValueError):
+        eng.submit(_prompts(cfg, [5])[0], max_new_tokens=2, deadline_s=-1.0)
+
+
+def test_serving_config_robustness_defaults():
+    """The robustness knobs are off by default — no queue cap, no deadline,
+    reject-on-overload (inert without a cap), bounded retries."""
+    c = ServingConfig()
+    assert c.max_queue_depth is None
+    assert c.deadline_s is None
+    assert c.overload_policy == "reject"
+    assert c.max_retries == 2
+    assert c.max_idle_ticks == 100
