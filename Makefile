@@ -7,7 +7,7 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
-        reshard-smoke disagg-smoke chaos-smoke
+        reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -113,6 +113,18 @@ disagg-smoke:
 # docs/usage_guides/serving.md "Serving under faults".
 chaos-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.chaos_smoke
+
+# Training-under-fire gate: a 10-step toy loop replays one seeded chaos
+# schedule twice (torn checkpoint write -> save retry, two nonfinite_grad
+# steps -> sentinel rollback, a slow_step straggler -> watchdog
+# training_stalled event naming the rank). Both chaos runs must draw a
+# bit-identical fault log, the chaos final loss must be bit-equal to a
+# fault-free run (rollback restored exact state + data order), and the
+# telemetry recompile counter must not move after the two-step warmup —
+# including across the rollback replay. See
+# docs/usage_guides/fault_tolerance.md "Training under fire".
+chaos-train-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.chaos_train_smoke
 
 # Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
 # search must be deterministic (byte-identical JSON), every candidate must

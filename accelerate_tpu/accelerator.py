@@ -686,6 +686,7 @@ class Accelerator:
             # the launcher signals the whole local gang (multi-host coherence
             # goes through check_preemption's collective).
             self.fault_tolerance.install_signal_handlers()
+            self.fault_tolerance.start_watchdog()
         return result[0] if len(result) == 1 else tuple(result)
 
     def _maybe_elastic_resume(self) -> None:
@@ -1231,6 +1232,7 @@ class Accelerator:
                 self._dataloaders.append(data_loader)
             data_loader._telemetry = self.telemetry
             data_loader._compile_manager = self.compile_manager
+            data_loader._fault_tolerance = self.fault_tolerance
             return data_loader
         cfg = self.dataloader_config
         prepared = prepare_data_loader(
@@ -1250,6 +1252,7 @@ class Accelerator:
         )
         prepared._telemetry = self.telemetry  # host-wait accounting hook
         prepared._compile_manager = self.compile_manager  # bucket padding hook
+        prepared._fault_tolerance = self.fault_tolerance  # chaos corrupt_batch hook
         self._dataloaders.append(prepared)
         return prepared
 

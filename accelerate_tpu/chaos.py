@@ -1,16 +1,19 @@
-"""Deterministic fault injection for the serving stack (layer L7).
+"""Deterministic fault injection for the serving AND training stacks.
 
 Production characterizations of distributed DL deployments show failure
 behavior under load — not peak throughput — dominates deployed performance
-(arXiv:2505.12832, PAPERS.md). The serving engines therefore carry a
-request-lifecycle robustness layer (admission control, retries, lane
-quarantine, degraded fallback — serving.py / disagg.py), and THIS module is
-how that layer gets exercised: a seed-driven :class:`FaultInjector` whose
-schedule is **fully determined by ``(seed, injection_point, tick)``** — no
-wall-clock, no global RNG — so a chaos run replays exactly, twice, anywhere.
+(arXiv:2505.12832, PAPERS.md). The serving engines carry a request-lifecycle
+robustness layer (admission control, retries, lane quarantine, degraded
+fallback — serving.py / disagg.py) and the training loop carries its own
+(atomic checkpoints, divergence rollback, preemption resume, the step
+watchdog — fault_tolerance.py); THIS module is how both get exercised: a
+seed-driven :class:`FaultInjector` whose schedule is **fully determined by
+``(seed, injection_point, tick, unit)``** — no wall-clock, no global RNG —
+so a chaos run replays exactly, twice, anywhere. The hash is keyed by the
+point NAME, so adding points never moves an existing schedule.
 
-Injection points (registered by the engines at the four places a real
-deployment fails):
+Serving injection points (registered by the engines at the four places a
+real deployment fails):
 
 - ``prefill_dispatch`` — the jitted prefill chunk dispatch (colocated slot
   write, or a disagg lane's private cache write);
@@ -19,7 +22,7 @@ deployment fails):
 - ``handoff_device_put`` — the disagg KV-page transfer to the decode mesh;
 - ``lane_health`` — a prefill lane's liveness check at dispatch.
 
-Fault kinds:
+Serving fault kinds:
 
 - ``transfer_error`` — a raised transfer/dispatch error (``u < 0.75``:
   transient, one failed attempt; else persistent — every retry fails, which
@@ -33,10 +36,36 @@ Fault kinds:
   ``decode_tick``, a live slot's page in place) is overwritten with NaN,
   which the decode-side sentinel must catch.
 
+Training injection points (drawn by the fault-tolerance manager when a
+``FaultToleranceKwargs(chaos=...)`` handler arms it — fault_tolerance.py):
+
+- ``train_step`` — after each prepared step's lagged metric fetch
+  (``tick`` = monotonic observe count, ``unit`` = process index);
+- ``collective_op`` — before the watchdog's gang-heartbeat collective;
+- ``checkpoint_save`` — inside the save-retry loop (``tick`` = save index,
+  ``unit`` = attempt, so a torn first attempt retries clean);
+- ``dataloader_batch`` — at the loader's device_put boundary;
+- ``host_heartbeat`` — the per-step host liveness draw.
+
+Training fault kinds:
+
+- ``nonfinite_grad`` — the metrics the divergence sentinel sees turn NaN
+  (model state untouched, so a rollback replay stays bit-equal);
+- ``slow_step`` — a deterministic host-side delay (``slow_step_s`` seconds,
+  or the schedule entry's ``seconds``) — the straggler the watchdog must
+  name;
+- ``torn_write`` — the checkpoint save attempt raises, driving the
+  retry/backoff → fallback-dir path;
+- ``corrupt_batch`` — the batch is NaN-poisoned at the device boundary, so
+  a REAL divergence flows through sentinel → rollback;
+- ``dead_host`` — the process exits with a chosen code (schedule entry's
+  ``exit_code``, default :data:`DEAD_HOST_DEFAULT_EXIT_CODE`), driving the
+  launch supervisor's classify → backoff → relaunch path.
+
 Off by default everywhere: no injector exists unless you construct one and
-pass it to an engine (``ServingEngine(..., chaos=...)``); the import is
-lazy-safe (numpy only) and the serving hot path holds a single ``is None``
-check per site.
+pass it to an engine (``ServingEngine(..., chaos=...)``) or to
+``FaultToleranceKwargs(chaos=...)``; the import is lazy-safe (numpy only)
+and the hot paths hold a single ``is None`` check per site.
 
 Usage::
 
@@ -64,17 +93,33 @@ __all__ = [
     "InjectedFaultError",
     "INJECTION_POINTS",
     "FAULT_KINDS",
+    "DEAD_HOST_DEFAULT_EXIT_CODE",
     "deterministic_jitter",
 ]
 
 INJECTION_POINTS = (
+    # serving (PR 9)
     "prefill_dispatch",
     "decode_tick",
     "handoff_device_put",
     "lane_health",
+    # training (fault_tolerance.py hooks)
+    "train_step",
+    "collective_op",
+    "checkpoint_save",
+    "dataloader_batch",
+    "host_heartbeat",
 )
 
-FAULT_KINDS = ("transfer_error", "delay", "dead_lane", "poison")
+FAULT_KINDS = (
+    "transfer_error", "delay", "dead_lane", "poison",
+    "nonfinite_grad", "slow_step", "torn_write", "corrupt_batch", "dead_host",
+)
+
+# An injected dead host exits 139 (128 + SIGSEGV) unless the schedule entry
+# picks another code: the supervisor's classifier reads 128+signal codes as
+# hardware-ish death, distinct from a clean deterministic crash.
+DEAD_HOST_DEFAULT_EXIT_CODE = 139
 
 # Which kinds make sense where — rates naming other combos are rejected at
 # construction so a typo'd chaos spec fails loudly, not silently-never-fires.
@@ -83,6 +128,11 @@ _POINT_KINDS = {
     "decode_tick": ("poison",),
     "handoff_device_put": ("transfer_error", "delay", "poison"),
     "lane_health": ("dead_lane",),
+    "train_step": ("nonfinite_grad", "slow_step"),
+    "collective_op": ("slow_step",),
+    "checkpoint_save": ("torn_write",),
+    "dataloader_batch": ("corrupt_batch",),
+    "host_heartbeat": ("dead_host",),
 }
 
 _MASK = (1 << 64) - 1
@@ -119,13 +169,16 @@ def deterministic_jitter(seed: int, tick: int, attempt: int) -> float:
 class Fault(NamedTuple):
     """One drawn fault. ``u`` is the residual uniform the engine uses for
     deterministic sub-decisions (e.g. transient vs persistent transfer
-    errors) without another RNG."""
+    errors) without another RNG. ``extra`` carries a schedule entry's
+    pass-through fields (``seconds`` for ``slow_step``, ``exit_code`` for
+    ``dead_host``); rate-driven faults leave it None."""
 
     point: str
     kind: str
     tick: int
     unit: int
     u: float
+    extra: Optional[dict] = None
 
 
 class InjectedFaultError(RuntimeError):
@@ -156,18 +209,28 @@ class FaultInjector:
       lane".
     - ``delay_ticks``: how many ticks a ``delay`` fault defers a handoff's
       background insert.
+    - ``slow_step_s``: seconds a rate-driven ``slow_step`` fault sleeps
+      (schedule entries override per-fault via ``{"seconds": ...}``).
+
+    Schedule entries may carry pass-through fields beyond the matchers —
+    ``seconds`` (slow_step) and ``exit_code`` (dead_host) ride on
+    :attr:`Fault.extra`.
 
     ``injected`` logs every fault actually drawn, in draw order — two runs
     with the same seed, config, and trace produce identical logs (pinned by
-    tests/test_chaos.py and ``make chaos-smoke``).
+    tests/test_chaos.py, ``make chaos-smoke`` and ``make chaos-train-smoke``).
     """
 
     def __init__(self, seed: int = 0, rates: Optional[dict] = None,
-                 schedule: Optional[list] = None, delay_ticks: int = 3):
+                 schedule: Optional[list] = None, delay_ticks: int = 3,
+                 slow_step_s: float = 0.1):
         self.seed = int(seed)
         self.delay_ticks = int(delay_ticks)
         if self.delay_ticks < 1:
             raise ValueError(f"delay_ticks must be >= 1, got {delay_ticks}")
+        self.slow_step_s = float(slow_step_s)
+        if self.slow_step_s < 0:
+            raise ValueError(f"slow_step_s must be >= 0, got {slow_step_s}")
         self.rates: dict[str, dict[str, float]] = {}
         for point, spec in (rates or {}).items():
             if point not in INJECTION_POINTS:
@@ -207,6 +270,12 @@ class FaultInjector:
                     f"legal: {_POINT_KINDS[point]}"
                 )
             e.setdefault("count", 1)
+            # Anything beyond the matcher keys rides on Fault.extra (e.g.
+            # seconds= for slow_step, exit_code= for dead_host).
+            e["extra"] = {
+                k: v for k, v in e.items()
+                if k not in ("point", "kind", "tick", "unit", "count", "extra")
+            } or None
             self._schedule.append(e)
         self.injected: list[dict] = []
 
@@ -227,7 +296,9 @@ class FaultInjector:
             if entry.get("unit") is not None and int(entry["unit"]) != unit:
                 continue
             entry["count"] -= 1
-            return self._log(Fault(point, entry["kind"], tick, unit, u))
+            return self._log(
+                Fault(point, entry["kind"], tick, unit, u, entry["extra"])
+            )
         # Rate-driven: walk the point's kinds in declaration order against
         # the single uniform — cumulative, so at most one kind fires.
         acc = 0.0

@@ -82,8 +82,10 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_load: bool = Fal
                 chosen = folders[-1]
             # Continue numbering past the NEWEST existing checkpoint (even a
             # torn one the verified walk skipped) so the next save doesn't
-            # clobber anything (reference: accelerator.py load_state sets
-            # iteration = current + 1). Done here — the single resolution
+            # clobber anything. This deliberately goes beyond the reference,
+            # which never bumps ``iteration`` on load (reference:
+            # accelerator.py load_state) and instead errors at save time if
+            # the slot already exists. Done here — the single resolution
             # point — because load_state may pre-resolve for its pre-hooks,
             # after which load_accelerator_state sees a non-None input_dir.
             from .fault_tolerance import checkpoint_index
@@ -238,7 +240,7 @@ def _load_distributed_state(accelerator, state, input_dir: str):
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, target)
     return state.replace(
-        step=jnp.asarray(restored["step"], jnp.int32),
+        step=_restore_scalar_like(restored["step"], state.step, jnp.int32),
         params=restored["params"],
         opt_state=restored["opt_state"],
         extra_state=restored.get("extra_state", state.extra_state),
@@ -497,6 +499,23 @@ def save_accelerator_state(
     return output_dir
 
 
+def _restore_scalar_like(value, live, dtype):
+    """Device-put a restored scalar onto the LIVE array's sharding. A bare
+    ``jnp.asarray`` lands uncommitted on device 0; that input signature
+    differs from the jitted train step's committed, mesh-replicated output,
+    so the first post-restore step would silently recompile — which the
+    in-process rollback path (fault_tolerance.py sentinel="rollback") cannot
+    afford: the chaos-train smoke pins 0 steady-state recompiles across a
+    rollback."""
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(np.asarray(value), dtype)
+    sharding = getattr(live, "sharding", None)
+    if sharding is not None:
+        arr = jax.device_put(arr, sharding)
+    return arr
+
+
 def _restore_loss_scale(state, input_dir: str):
     loss_scale = state.loss_scale
     scaler_path = os.path.join(input_dir, f"{SCALER_NAME}.bin")
@@ -506,8 +525,10 @@ def _restore_loss_scale(state, input_dir: str):
         with open(scaler_path, "rb") as f:
             sc = pickle.load(f)
         loss_scale = loss_scale.replace(
-            scale=jnp.asarray(sc["scale"], jnp.float32),
-            growth_tracker=jnp.asarray(sc["growth_tracker"], jnp.int32),
+            scale=_restore_scalar_like(sc["scale"], loss_scale.scale, jnp.float32),
+            growth_tracker=_restore_scalar_like(
+                sc["growth_tracker"], loss_scale.growth_tracker, jnp.int32
+            ),
         )
     return loss_scale
 
@@ -621,7 +642,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
             extra_state = jax.tree.map(lambda a: jnp.asarray(a), loaded_extra)
 
     accelerator._train_state = state.replace(
-        step=jnp.asarray(opt_payload["step"], jnp.int32),
+        step=_restore_scalar_like(opt_payload["step"], state.step, jnp.int32),
         params=new_params,
         opt_state=new_opt,
         loss_scale=loss_scale,
@@ -683,7 +704,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None) -> str:
                 else jax.tree.map(lambda a: jnp.asarray(a), payload_i["extra_state"])
             )
         accelerator._train_states[i] = extra_st.replace(
-            step=jnp.asarray(payload_i["step"], jnp.int32),
+            step=_restore_scalar_like(payload_i["step"], extra_st.step, jnp.int32),
             params=new_params_i,
             opt_state=new_opt_i,
             extra_state=extra_i,

@@ -278,7 +278,9 @@ def estimate_topology_command(args: argparse.Namespace) -> int:
             "parallelism": args.parallelism,
             "seq": args.seq,
             "per_chip": {
-                **{k.replace(" ", "_"): round(v, 4) for k, v in est.rows()},
+                # rows() ends with a "total" row for the text table; the JSON
+                # shape already carries it as total_gib, so drop the duplicate.
+                **{k.replace(" ", "_"): round(v, 4) for k, v in est.rows() if k != "total"},
                 "total_gib": round(est.total_gib, 4),
                 "fits": fits,
                 "hbm_gib": args.hbm_gib,

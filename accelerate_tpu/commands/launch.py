@@ -23,8 +23,15 @@ import os
 import signal
 import subprocess
 import sys
+import time
+from typing import NamedTuple, Optional
 
 from .config_args import LaunchConfig, load_config_file
+from ..utils.constants import (
+    POISONED_CHECKPOINT_EXIT_CODE,
+    PREEMPTION_EXIT_CODE,
+    TRAINING_STALLED_EXIT_CODE,
+)
 
 
 def add_launch_args(p: argparse.ArgumentParser):
@@ -66,6 +73,16 @@ def add_launch_args(p: argparse.ArgumentParser):
                          "worker failure (fresh rendezvous each attempt)")
     el.add_argument("--monitor_interval", type=float, default=0.2,
                     help="Seconds between worker health polls")
+    el.add_argument("--restart_backoff", type=float, default=1.0,
+                    help="Base seconds of capped exponential backoff between "
+                         "gang restarts (0 disables; preemption restarts are "
+                         "never delayed)")
+    el.add_argument("--restart_backoff_cap", type=float, default=30.0,
+                    help="Ceiling on the restart backoff delay")
+    el.add_argument("--shrink_after_dead_hosts", type=int, default=0,
+                    help="After N consecutive dead-host exits, relaunch the "
+                         "local gang at a planner-validated smaller size and "
+                         "let the elastic resume reshard (0 = off)")
 
     pod = p.add_argument_group("pod launch (ssh fan-out, reference tpu_pod_launcher)")
     pod.add_argument("--pod_hosts", default=None,
@@ -130,6 +147,134 @@ def _spawn(cmd, env, rank: int | None = None) -> subprocess.Popen:
     return subprocess.Popen(cmd, env=env)
 
 
+# ----------------------------------------------------------------------
+# Failure-classifying gang supervisor
+# ----------------------------------------------------------------------
+
+
+def classify_exit(rc: int) -> str:
+    """Map a gang exit code to a failure class the supervisor acts on.
+
+    The resumable protocol codes come first (workers choose them on purpose:
+    fault_tolerance.py preemption/watchdog/divergence paths); everything else
+    is inferred from POSIX conventions — negative rc is a Popen "killed by
+    signal", 128+N is a shell-style signal death (the chaos ``dead_host``
+    default is 139 = 128+SIGSEGV)."""
+    if rc == 0:
+        return "ok"
+    if rc == 130 or rc == -signal.SIGINT:
+        return "interrupted"
+    if rc == PREEMPTION_EXIT_CODE:
+        return "preempted"
+    if rc == TRAINING_STALLED_EXIT_CODE:
+        return "stalled"
+    if rc == POISONED_CHECKPOINT_EXIT_CODE:
+        return "poisoned"
+    if rc == 137 or rc == -signal.SIGKILL:
+        # SIGKILL is almost always the kernel OOM killer on a training host.
+        return "oom"
+    if rc < 0 or 128 < rc < 160:
+        return "dead-host"
+    return "fatal"
+
+
+def _backoff_s(n_restarts: int, base_s: float, cap_s: float) -> float:
+    """Capped exponential backoff with deterministic jitter (±25%, keyed on
+    the restart index via a Weyl-style multiplier so repeated runs of the
+    same failure sequence sleep identically — no RNG, replayable)."""
+    if base_s <= 0:
+        return 0.0
+    delay = min(cap_s, base_s * (2.0 ** n_restarts))
+    frac = ((n_restarts + 1) * 2654435761 % 1000) / 1000.0
+    return delay * (0.75 + 0.5 * frac)
+
+
+class SupervisorDecision(NamedTuple):
+    action: str  # "stop" | "restart" | "refuse"
+    classification: str  # classify_exit() result
+    delay_s: float = 0.0
+    num_processes: Optional[int] = None  # set when the gang should shrink
+    reason: str = ""
+
+
+class GangSupervisor:
+    """Restart policy for the local gang loop: classify each exit, spend the
+    restart budget with capped backoff, shrink the topology after repeated
+    dead-host deaths, and refuse to thrash on crashes a restart cannot fix
+    (poisoned checkpoints, the same fatal rc twice in quick succession).
+
+    Pure state machine over (rc, uptime, world size) → decision; the launch
+    loop owns the side effects (sleeping, respawning, stderr). Unit-tested
+    directly in tests/test_cli.py."""
+
+    def __init__(
+        self,
+        max_restarts: int,
+        backoff_s: float = 1.0,
+        backoff_cap_s: float = 30.0,
+        shrink_after: int = 0,
+        fatal_repeat_limit: int = 2,
+        thrash_uptime_s: float = 60.0,
+        layout: Optional[dict] = None,
+    ):
+        self.max_restarts = max(0, int(max_restarts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.shrink_after = max(0, int(shrink_after))
+        self.fatal_repeat_limit = max(1, int(fatal_repeat_limit))
+        self.thrash_uptime_s = float(thrash_uptime_s)
+        self.layout = layout
+        self.restarts_used = 0
+        self._dead_streak = 0
+        # Recent fast fatal exit codes; None breaks a streak (a slow crash
+        # had time to make progress, so it may not be deterministic).
+        self._fatal_history: list = []
+
+    def decide(self, rc: int, uptime_s: float, num_processes: int) -> SupervisorDecision:
+        cls = classify_exit(rc)
+        if cls in ("ok", "interrupted"):
+            return SupervisorDecision("stop", cls)
+        if cls == "poisoned":
+            return SupervisorDecision(
+                "refuse", cls,
+                reason="the divergence reproduces from the newest checkpoint; "
+                       "a relaunch replays the same failure",
+            )
+        if cls == "fatal":
+            self._fatal_history.append(rc if uptime_s < self.thrash_uptime_s else None)
+            recent = self._fatal_history[-self.fatal_repeat_limit:]
+            if len(recent) == self.fatal_repeat_limit and all(r == rc for r in recent):
+                return SupervisorDecision(
+                    "refuse", cls,
+                    reason=f"rc={rc} repeated {self.fatal_repeat_limit}x within "
+                           f"{self.thrash_uptime_s:.0f}s of launch — the crash "
+                           "is deterministic, restarting would thrash",
+                )
+        else:
+            self._fatal_history.clear()
+        if self.restarts_used >= self.max_restarts:
+            return SupervisorDecision(
+                "stop", cls,
+                reason=f"restart budget exhausted ({self.max_restarts})",
+            )
+        new_procs = None
+        if cls == "dead-host":
+            self._dead_streak += 1
+            if self.shrink_after and self._dead_streak >= self.shrink_after:
+                from ..resharding import shrink_world_size
+
+                shrunk = shrink_world_size(num_processes, lost=1, layout=self.layout)
+                if shrunk is not None and shrunk < num_processes:
+                    new_procs = shrunk
+                    self._dead_streak = 0
+        else:
+            self._dead_streak = 0
+        n = self.restarts_used
+        self.restarts_used += 1
+        delay = 0.0 if cls == "preempted" else _backoff_s(n, self.backoff_s, self.backoff_cap_s)
+        return SupervisorDecision("restart", cls, delay_s=delay, num_processes=new_procs)
+
+
 def launch_command(args: argparse.Namespace) -> int:
     cfg = resolve_launch_config(args)
     if getattr(args, "pod_hosts", None):
@@ -170,44 +315,88 @@ def launch_command(args: argparse.Namespace) -> int:
         }
         return subprocess.call(cmd, env=env)
 
-    # Local fan-out: all processes on this machine. The whole gang restarts
-    # together up to --max_restarts times after any worker failure (the
-    # reference delegates this to torch elastic's max_restarts,
-    # commands/launch.py:998-1030); each attempt gets a fresh rendezvous port
-    # so stale coordinator state can't poison the retry.
+    # Local fan-out: all processes on this machine. The gang restarts
+    # together under the failure-classifying supervisor (the reference
+    # delegates this to torch elastic's max_restarts,
+    # commands/launch.py:998-1030): resumable protocol exits (preemption 75,
+    # watchdog stall 76) and crash-like deaths spend the --max_restarts
+    # budget with capped backoff; poisoned checkpoints (77) and repeated
+    # identical fast crashes end the run instead of thrashing; repeated
+    # dead-host exits can shrink the gang (--shrink_after_dead_hosts). Each
+    # attempt gets a fresh rendezvous port so stale coordinator state can't
+    # poison the retry.
     max_restarts = max(0, int(getattr(args, "max_restarts", 0) or 0))
     monitor_interval = float(getattr(args, "monitor_interval", 0.2) or 0.2)
-    from ..utils.constants import PREEMPTION_EXIT_CODE
-
-    for attempt in range(max_restarts + 1):
+    supervisor = GangSupervisor(
+        max_restarts=max_restarts,
+        backoff_s=float(getattr(args, "restart_backoff", 1.0) or 0.0),
+        backoff_cap_s=float(getattr(args, "restart_backoff_cap", 30.0) or 0.0),
+        shrink_after=int(getattr(args, "shrink_after_dead_hosts", 0) or 0),
+    )
+    attempt = 0
+    while True:
+        started = time.monotonic()
         rc = _run_gang(cmd, base_env, cfg, port, monitor_interval, attempt)
-        if rc in (0, 130):
+        decision = supervisor.decide(rc, time.monotonic() - started, cfg.num_processes)
+        left = max_restarts - supervisor.restarts_used
+        if decision.action == "stop":
+            if decision.reason:
+                print(
+                    f"[accelerate-tpu] attempt {attempt} exited rc={rc} "
+                    f"({decision.classification}); {decision.reason}",
+                    file=sys.stderr,
+                )
             return rc
-        if attempt < max_restarts:
-            if rc == PREEMPTION_EXIT_CODE:
-                # A preemption-triggered save completed and the workers asked
-                # for a resumable restart (fault_tolerance.py): the relaunch
-                # carries ACCELERATE_RESTART_ATTEMPT so elastic auto-resume
-                # continues from the preemption checkpoint. If the relaunch
-                # lands on a different device count, an ElasticKwargs handler
-                # reshards the restore onto whatever came back
-                # (resharding.py); without one the mismatched load fails
-                # fast with both topologies named.
-                print(
-                    f"[accelerate-tpu] attempt {attempt}: preemption save "
-                    f"complete (rc={rc}); relaunching gang to resume "
-                    f"({max_restarts - attempt} restarts left; a changed "
-                    f"slice size reshards under ElasticKwargs)",
-                    file=sys.stderr,
-                )
-            else:
-                print(
-                    f"[accelerate-tpu] attempt {attempt} failed (rc={rc}); "
-                    f"restarting gang ({max_restarts - attempt} restarts left)",
-                    file=sys.stderr,
-                )
-            port = None  # re-draw a fresh port next attempt
-    return rc
+        if decision.action == "refuse":
+            print(
+                f"[accelerate-tpu] attempt {attempt} exited rc={rc} "
+                f"({decision.classification}); refusing to relaunch: "
+                f"{decision.reason}",
+                file=sys.stderr,
+            )
+            return rc
+        if decision.num_processes is not None:
+            # Repeated dead-host deaths: relaunch smaller and let the elastic
+            # resume reshard the newest verified checkpoint onto the shrunken
+            # gang (resharding.py shrink_world_size picked a size the planner
+            # validates).
+            print(
+                f"[accelerate-tpu] shrinking gang "
+                f"{cfg.num_processes} -> {decision.num_processes} processes "
+                "after repeated dead-host exits",
+                file=sys.stderr,
+            )
+            cfg.num_processes = decision.num_processes
+            base_env = {**base_env, **cfg.to_env()}
+        if decision.classification == "preempted":
+            # A preemption-triggered save completed and the workers asked
+            # for a resumable restart (fault_tolerance.py): the relaunch
+            # carries ACCELERATE_RESTART_ATTEMPT so elastic auto-resume
+            # continues from the preemption checkpoint. If the relaunch
+            # lands on a different device count, an ElasticKwargs handler
+            # reshards the restore onto whatever came back (resharding.py);
+            # without one the mismatched load fails fast with both
+            # topologies named.
+            print(
+                f"[accelerate-tpu] attempt {attempt}: preemption save "
+                f"complete (rc={rc}); relaunching gang to resume "
+                f"({left} restarts left; a changed "
+                f"slice size reshards under ElasticKwargs)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"[accelerate-tpu] attempt {attempt} failed (rc={rc}, "
+                f"{decision.classification}); restarting gang "
+                f"({left} restarts left"
+                + (f"; backoff {decision.delay_s:.1f}s" if decision.delay_s else "")
+                + ")",
+                file=sys.stderr,
+            )
+        if decision.delay_s:
+            time.sleep(decision.delay_s)
+        port = None  # re-draw a fresh port next attempt
+        attempt += 1
 
 
 def _run_gang(cmd, base_env, cfg, port, monitor_interval: float, attempt: int) -> int:

@@ -459,6 +459,11 @@ class BaseDataLoader:
         # ragged stream compiles at most len(buckets) executables. None =
         # ship true shapes, byte-identical to the unmanaged path.
         self._compile_manager = None
+        # Set by Accelerator.prepare_data_loader when fault tolerance is on:
+        # chaos `corrupt_batch` faults poison this loader's batches at the
+        # device boundary (fault_tolerance.py draw_batch_fault). None (or a
+        # manager with no injector armed) = batches ship untouched.
+        self._fault_tolerance = None
 
     # -- device side -----------------------------------------------------
 
@@ -494,6 +499,18 @@ class BaseDataLoader:
         When the compile manager is on, the batch is padded to bucket shapes
         HERE — the device boundary — so everything downstream (device_put,
         telemetry digests, the jitted step) only ever sees bucket shapes."""
+        ft = self._fault_tolerance
+        if ft is not None and ft.draw_batch_fault() is not None:
+            # Chaos `corrupt_batch`: NaN out every float leaf. The poison is
+            # real — it flows through the jitted step and produces genuinely
+            # non-finite loss/grads, exercising the sentinel → rollback path
+            # end to end (shapes/dtypes unchanged, so no recompile).
+            batch = recursively_apply(
+                lambda a: np.full_like(a, np.nan)
+                if np.issubdtype(np.asarray(a).dtype, np.floating)
+                else a,
+                _to_numpy_tree(batch),
+            )
         if not self.device_placement:
             return batch
         cm = self._compile_manager
@@ -728,9 +745,12 @@ class DataLoaderDispatcher(BaseDataLoader):
         # fixed cost to ~1 ms/batch. Same batches, same order — only the
         # collective cadence changes; every rank buffers one group.
         self.dispatch_group_size = max(1, int(dispatch_group_size))
-        # Byte cap on a read-ahead group (large batches: bandwidth dominates
-        # the collective, so grouping past this just spikes host memory).
-        self.dispatch_group_bytes = 8 << 20
+        # Byte cap on a read-ahead group. Grouping only amortizes the
+        # collective's FIXED cost, which stops mattering above ~1 MB payloads
+        # (see _raw_batches) — so the cap sits AT 1 MiB: beyond it bandwidth
+        # dominates and read-ahead just spikes host memory and
+        # time-to-first-batch. Pinned by tests/test_data_loader.py.
+        self.dispatch_group_bytes = 1 << 20
         if PartialState().num_processes > 1:
             # Dispatch mode runs broadcast collectives inside _raw_batches;
             # those must stay on the main thread, interleaved in the same

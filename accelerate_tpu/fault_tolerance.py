@@ -29,6 +29,29 @@ has a narrow, composable answer here:
   nonfinite or exploding steps, then applies policy ``warn | halt |
   rollback``; rollback restores the newest *verified* checkpoint and
   re-primes RNG/dataloader state so the run resumes deterministically.
+- **Step watchdog** — large-scale practice (arXiv:2004.13336, PAPERS.md)
+  shows step-time anomalies and silent hangs, not clean crashes, dominate:
+  :class:`StepWatchdog` notes every completed step (same one-step-lag trick
+  as the sentinel — the note itself never blocks dispatch) while a host
+  thread polls the note's age. Past ``watchdog_warn_s`` it emits a
+  ``training_stalled`` telemetry event naming the straggling rank with
+  per-rank last-step ages; past ``watchdog_stall_s`` it escalates per
+  policy: ``warn`` keeps logging, ``error`` raises
+  :class:`TrainingStalledError` at the next completed step, ``preempt``
+  SIGTERMs itself (the preemption save path, if the loop is alive) and
+  hard-exits ``TRAINING_STALLED_EXIT_CODE`` after a grace period so the
+  launch supervisor relaunches from the newest verified checkpoint. With
+  ``watchdog_heartbeat_every`` > 0 a multi-process gang also allgathers
+  (step, age) every N steps over the ``agree_any``-style channel, so a
+  stalled PEER is detected rank-coherently.
+- **Chaos injection** — a :class:`~accelerate_tpu.chaos.FaultInjector`
+  passed as ``FaultToleranceKwargs(chaos=...)`` drives deterministic
+  training faults through the SAME paths real ones take: ``nonfinite_grad``
+  → sentinel → rollback, ``torn_write`` → save retry/backoff → fallback,
+  ``corrupt_batch`` → a real NaN loss → rollback, ``slow_step`` → the
+  watchdog's straggler ladder, ``dead_host`` → process exit → the launch
+  supervisor's classify/backoff/relaunch. Replay the same seed and the
+  fault schedule — and the recovery — reproduce exactly.
 
 Default off: without a :class:`~accelerate_tpu.utils.FaultToleranceKwargs`
 handler, ``accelerator.fault_tolerance`` is ``None``, every hook is a single
@@ -48,6 +71,7 @@ import random
 import re
 import shutil
 import signal
+import threading
 import time
 from typing import Callable, Optional
 
@@ -58,7 +82,9 @@ from .utils.constants import (
     CHECKPOINT_DIR_REGEX,
     CHECKPOINT_MANIFEST_NAME,
     CHECKPOINT_STAGING_SUFFIX,
+    POISONED_CHECKPOINT_EXIT_CODE,
     PREEMPTION_EXIT_CODE,
+    TRAINING_STALLED_EXIT_CODE,
 )
 
 logger = get_logger(__name__)
@@ -75,7 +101,29 @@ class CheckpointSaveError(RuntimeError):
 
 class DivergenceError(RuntimeError):
     """The divergence sentinel halted training (policy ``halt``, or
-    ``rollback`` with no verified checkpoint / retries exhausted)."""
+    ``rollback`` with no verified checkpoint / retries exhausted).
+    ``exit_code`` is what a supervised training script should exit with:
+    the launch supervisor classifies it poisoned-checkpoint and refuses to
+    relaunch (the same checkpoint would reproduce the same divergence)."""
+
+    exit_code = POISONED_CHECKPOINT_EXIT_CODE
+
+
+class TrainingStalledError(RuntimeError):
+    """The step watchdog (policy ``error``) detected a progress-free or
+    straggling gang. Carries ``ages`` ({rank: seconds since that rank's
+    last completed step}) and ``straggler`` (the most-behind rank).
+    ``exit_code`` is what a supervised script should exit with: the launch
+    supervisor classifies it stalled-but-resumable and relaunches from the
+    newest verified checkpoint."""
+
+    exit_code = TRAINING_STALLED_EXIT_CODE
+
+    def __init__(self, msg: str, ages: Optional[dict] = None,
+                 straggler: Optional[int] = None):
+        super().__init__(msg)
+        self.ages = dict(ages or {})
+        self.straggler = straggler
 
 
 def checkpoint_index(name: str) -> Optional[int]:
@@ -265,6 +313,252 @@ class DivergenceSentinel:
 
 
 # ---------------------------------------------------------------------------
+# Step watchdog
+# ---------------------------------------------------------------------------
+
+
+class StepWatchdog:
+    """Detects a progress-free or straggling gang without ever blocking the
+    step. Two detection paths share one escalation ladder:
+
+    - a daemon thread polls the age of this rank's last step note every
+      ``watchdog_poll_s`` (catches true hangs — the loop never gets to run
+      detection code itself);
+    - :meth:`note_step`, called from the lagged ``observe_step`` hook,
+      catches a slow-but-completed step on the spot and raises the
+      thread-flagged :class:`TrainingStalledError` under policy ``error``
+      (a thread cannot raise into the main thread; a completed step is the
+      first safe opportunity).
+
+    With ``watchdog_heartbeat_every`` > 0 on a multi-process gang,
+    :meth:`maybe_heartbeat` allgathers (step, age) across ranks every N
+    steps — the main-thread collective all ranks reach together — so a
+    stalled PEER is detected and named rank-coherently.
+
+    Escalation (once per stall episode; a completed step re-arms):
+    warn log + ``training_stalled`` event at ``warn_s`` → stall event at
+    ``stall_s`` → per policy: ``warn`` nothing more, ``error`` raise at the
+    next completed step, ``preempt`` SIGTERM self (the preemption-save path
+    if the loop is alive) then hard-exit ``TRAINING_STALLED_EXIT_CODE``
+    after ``grace_s`` more without progress.
+    """
+
+    def __init__(self, manager, handler):
+        self.manager = manager
+        self.policy = handler.watchdog
+        self.warn_s = float(handler.watchdog_warn_s)
+        self.stall_s = float(handler.watchdog_stall_s)
+        self.poll_s = float(handler.watchdog_poll_s)
+        self.heartbeat_every = int(handler.watchdog_heartbeat_every)
+        self.grace_s = float(handler.watchdog_grace_s)
+        self.warnings = 0
+        self.stalls = 0
+        self.escalations = 0
+        self.straggler_events = 0
+        self.heartbeats = 0
+        self.last_ages: Optional[dict] = None
+        self._last_note: Optional[float] = None
+        self._last_step = -1
+        self._episode_warned = False
+        self._episode_stalled = False
+        self._preempted_at: Optional[float] = None
+        self._pending_error: Optional[TrainingStalledError] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._last_note = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="accelerate-tpu-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.poll_s))
+
+    def age(self, now: Optional[float] = None) -> float:
+        if self._last_note is None:
+            return 0.0
+        return (now if now is not None else time.monotonic()) - self._last_note
+
+    # -- main-thread hooks -------------------------------------------------
+
+    def note_step(self, step: int) -> None:
+        """One completed step. Raises the thread-flagged stall under policy
+        ``error``; otherwise records a straggler episode the thread missed
+        (slow step shorter than a poll tick) and re-arms the episode."""
+        err, self._pending_error = self._pending_error, None
+        if err is not None:
+            self.escalations += 1
+            raise err
+        now = time.monotonic()
+        age = self.age(now)
+        if age > self.warn_s and not self._episode_warned:
+            self._record(age, level="straggler", source="step")
+        self._last_note = now
+        self._last_step = int(step)
+        self._episode_warned = False
+        self._episode_stalled = False
+        self._preempted_at = None
+
+    def maybe_heartbeat(self, tick: int) -> None:
+        """Every ``heartbeat_every`` steps: allgather (step, age) across the
+        gang and escalate on the most-behind PEER. A collective — every rank
+        must reach it at the same tick, which holds because every rank steps
+        the same loop."""
+        if not self.heartbeat_every or tick % self.heartbeat_every:
+            return
+        state = self.manager.accelerator.state
+        if state.num_processes <= 1:
+            return
+        rank = state.process_index
+        chaos = self.manager.chaos
+        if chaos is not None:
+            f = chaos.draw("collective_op", tick, unit=rank)
+            if f is not None:  # slow_step: delay OUR heartbeat — peers see it
+                self.manager._note_fault(f)
+                time.sleep(float((f.extra or {}).get(
+                    "seconds", chaos.slow_step_s)))
+        try:
+            table = state.allgather_host_floats(
+                [float(self._last_step), self.age()]
+            )
+        except Exception as e:  # a failed probe must never kill training
+            logger.warning(f"fault_tolerance: watchdog heartbeat failed: {e}")
+            return
+        self.heartbeats += 1
+        steps = [int(s) for s in table[:, 0]]
+        ages = [float(a) for a in table[:, 1]]
+        self.last_ages = {r: round(a, 3) for r, a in enumerate(ages)}
+        behind = max(ages)
+        if behind <= self.warn_s:
+            return
+        straggler = ages.index(behind)
+        level = "stall" if behind > self.stall_s else "straggler"
+        self.straggler_events += 1
+        self._emit(level, behind, source="heartbeat",
+                   ages=self.last_ages, straggler=straggler, steps=steps)
+        if level == "stall":
+            self.stalls += 1
+            msg = (
+                f"gang heartbeat: rank {straggler} last completed a step "
+                f"{behind:.1f}s ago (stall_s={self.stall_s:g}); per-rank "
+                f"ages {self.last_ages}"
+            )
+            if self.policy == "error":
+                self.escalations += 1
+                raise TrainingStalledError(
+                    msg, ages=self.last_ages, straggler=straggler
+                )
+            if self.policy == "preempt":
+                # Every rank computed the same table — the whole gang takes
+                # the same self-preempt decision, no extra collective needed.
+                self.escalations += 1
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- detection / escalation (thread + main paths) ----------------------
+
+    def _record(self, age: float, level: str, source: str) -> None:
+        """First warn of a stall episode."""
+        self._episode_warned = True
+        self.warnings += 1
+        rank = getattr(self.manager.accelerator, "process_index", 0)
+        self._emit(level, age, source=source,
+                   ages={rank: round(age, 3)}, straggler=rank)
+
+    def _emit(self, level: str, age: float, source: str, ages: dict,
+              straggler: int, steps: Optional[list] = None) -> None:
+        self.last_ages = {int(r): float(a) for r, a in ages.items()}
+        logger.warning(
+            "fault_tolerance: training stalled (%s, via %s) — rank %d has "
+            "not completed a step in %.2fs (last step %d; warn %gs / stall "
+            "%gs; policy %s).",
+            level, source, straggler, age, self._last_step,
+            self.warn_s, self.stall_s, self.policy,
+        )
+        fields = dict(
+            level=level, source=source, policy=self.policy,
+            straggler=int(straggler), age_s=round(age, 3),
+            last_step=self._last_step,
+            ages_s={str(r): round(float(a), 3) for r, a in ages.items()},
+        )
+        if steps is not None:
+            fields["rank_steps"] = steps
+        self.manager._event("training_stalled", **fields)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            age = self.age(now)
+            if age <= self.warn_s:
+                continue
+            if not self._episode_warned:
+                rank = getattr(self.manager.accelerator, "process_index", 0)
+                self._episode_warned = True
+                self.warnings += 1
+                self._emit("straggler", age, source="thread",
+                           ages={rank: round(age, 3)}, straggler=rank)
+            if age > self.stall_s and not self._episode_stalled:
+                self._episode_stalled = True
+                self.stalls += 1
+                rank = getattr(self.manager.accelerator, "process_index", 0)
+                self._emit("stall", age, source="thread",
+                           ages={rank: round(age, 3)}, straggler=rank)
+                self._escalate(age, rank)
+            if (
+                self._preempted_at is not None
+                and now - self._preempted_at > self.grace_s
+                and self.age() > self.grace_s
+            ):
+                # The SIGTERM save path never ran — the loop is truly stuck
+                # (e.g. blocked inside a collective). Flush what we can and
+                # die with the code the supervisor reads as "stalled,
+                # resume from the newest verified checkpoint".
+                logger.error(
+                    "fault_tolerance: watchdog grace period (%gs) expired "
+                    "with no progress after self-preempt — hard exit %d.",
+                    self.grace_s, TRAINING_STALLED_EXIT_CODE,
+                )
+                self.manager.flush_telemetry()
+                os._exit(TRAINING_STALLED_EXIT_CODE)
+
+    def _escalate(self, age: float, rank: int) -> None:
+        if self.policy == "warn":
+            return
+        self.escalations += 1
+        if self.policy == "error":
+            # Threads cannot raise into the main thread; flag it and the
+            # next completed step raises. A full hang never completes a
+            # step — use policy "preempt" for that failure mode.
+            self._pending_error = TrainingStalledError(
+                f"rank {rank} stalled: no step completed in {age:.2f}s "
+                f"(stall_s={self.stall_s:g})",
+                ages={rank: round(age, 3)}, straggler=rank,
+            )
+        elif self.policy == "preempt":
+            self._preempted_at = time.monotonic()
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def summary(self) -> dict:
+        return {
+            "policy": self.policy,
+            "warnings": self.warnings,
+            "stalls": self.stalls,
+            "escalations": self.escalations,
+            "straggler_events": self.straggler_events,
+            "heartbeats": self.heartbeats,
+            "last_ages_s": self.last_ages,
+        }
+
+
+# ---------------------------------------------------------------------------
 # Manager
 # ---------------------------------------------------------------------------
 
@@ -290,6 +584,25 @@ class FaultToleranceManager:
         self._pending_metrics = None
         self.rollbacks_done = 0
         self.save_retries_total = 0
+        # Chaos (chaos.py): a FaultInjector instance or its constructor
+        # kwargs. Ticks are MONOTONIC call counters, never the training step
+        # — a rollback rewinds the step but must not replay (re-fire) the
+        # injected fault, or the run would rollback forever.
+        chaos = handler.chaos
+        if isinstance(chaos, dict):
+            from .chaos import FaultInjector
+
+            chaos = FaultInjector(**chaos)
+        self.chaos = chaos
+        self.faults_injected = 0
+        self._step_ticks = 0
+        self._save_ticks = 0
+        self._batch_ticks = 0
+        # Step watchdog: armed at prepare() (start_watchdog), torn down in
+        # close().
+        self.watchdog: Optional[StepWatchdog] = None
+        if handler.watchdog != "off":
+            self.watchdog = StepWatchdog(self, handler)
         self._last_verified_dir: Optional[str] = None
         # Staging dirs save_state already cleared and seeded (pre-hook
         # sidecar files): save_accelerator_state must NOT re-wipe these as
@@ -302,6 +615,91 @@ class FaultToleranceManager:
         tel = getattr(self.accelerator, "telemetry", None)
         if tel is not None:
             tel.record_event(event, **fields)
+
+    def flush_telemetry(self) -> None:
+        """Best-effort final telemetry write before an injected/forced
+        process death, so the summary (fault + watchdog tallies) survives."""
+        tel = getattr(self.accelerator, "telemetry", None)
+        if tel is None:
+            return
+        try:
+            tel.close()
+        except Exception:  # pragma: no cover - dying anyway
+            pass
+
+    # -- chaos hooks -------------------------------------------------------
+
+    def _note_fault(self, fault) -> None:
+        self.faults_injected += 1
+        logger.warning(
+            "fault_tolerance: injected %s at %s (tick %d, unit %d)",
+            fault.kind, fault.point, fault.tick, fault.unit,
+        )
+        self._event(
+            "fault_injected", point=fault.point, kind=fault.kind,
+            tick=fault.tick, unit=fault.unit,
+        )
+
+    def _chaos_train_step(self, tick: int) -> bool:
+        """Per-step chaos draws. Returns True when the step's metrics must
+        be NaN-poisoned (``nonfinite_grad`` — the sentinel sees a divergence;
+        model state is untouched so the rollback replay stays bit-equal)."""
+        from .chaos import DEAD_HOST_DEFAULT_EXIT_CODE
+
+        rank = getattr(self.accelerator, "process_index", 0)
+        f = self.chaos.draw("host_heartbeat", tick, unit=rank)
+        if f is not None:  # dead_host: die like real hardware — no cleanup
+            self._note_fault(f)
+            code = int((f.extra or {}).get(
+                "exit_code", DEAD_HOST_DEFAULT_EXIT_CODE))
+            logger.error(
+                "fault_tolerance: injected dead_host — exiting %d "
+                "(tick %d, rank %d).", code, tick, rank,
+            )
+            self.flush_telemetry()
+            os._exit(code)
+        poison = False
+        f = self.chaos.draw("train_step", tick, unit=rank)
+        if f is not None:
+            self._note_fault(f)
+            if f.kind == "slow_step":
+                time.sleep(float((f.extra or {}).get(
+                    "seconds", self.chaos.slow_step_s)))
+            elif f.kind == "nonfinite_grad":
+                poison = True
+        return poison
+
+    def _chaos_save_attempt(self, tick: int, attempt: int) -> None:
+        """checkpoint_save/torn_write draw, one per (save, attempt) — a torn
+        first attempt retries clean, exercising the real backoff path."""
+        if self.chaos is None:
+            return
+        f = self.chaos.draw("checkpoint_save", tick, unit=attempt)
+        if f is not None:
+            self._note_fault(f)
+            from .chaos import InjectedFaultError
+
+            raise InjectedFaultError(f)
+
+    def draw_batch_fault(self):
+        """dataloader_batch draw at the loader's device_put boundary; the
+        loader NaN-poisons the batch on a fault (data_loader.py), producing
+        a REAL divergence the sentinel must roll back."""
+        if self.chaos is None:
+            return None
+        tick = self._batch_ticks
+        self._batch_ticks += 1
+        f = self.chaos.draw(
+            "dataloader_batch", tick,
+            unit=getattr(self.accelerator, "process_index", 0),
+        )
+        if f is not None:
+            self._note_fault(f)
+        return f
+
+    def start_watchdog(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.start()
 
     # -- atomic commit -----------------------------------------------------
 
@@ -467,8 +865,15 @@ class FaultToleranceManager:
         h = self.handler
         delay = max(0.0, float(h.retry_backoff_s))
         last_err: Optional[Exception] = None
+        save_tick = self._save_ticks
+        self._save_ticks += 1
         for attempt in range(max(0, int(h.save_retries)) + 1):
             try:
+                # Injected torn_write faults raise here, per (save, attempt),
+                # and flow through the identical retry/backoff/fallback path
+                # a real storage flake takes. The fallback attempt below is
+                # left clean — its coverage target is the primary dir dying.
+                self._chaos_save_attempt(save_tick, attempt)
                 out = do_save(target_dir)
                 self._note_preemption_save(out)
                 return out
@@ -598,12 +1003,25 @@ class FaultToleranceManager:
 
     def observe_step(self, metrics, slot: int = 0):
         """Called by the prepared step wrapper after every step. Returns a
-        replacement TrainState when a rollback restored one, else ``None``."""
+        replacement TrainState when a rollback restored one, else ``None``.
+        Chaos draws and watchdog notes run first — they are live even with
+        the sentinel off."""
+        tick = self._step_ticks
+        self._step_ticks += 1
+        poison = False
+        if self.chaos is not None:
+            poison = self._chaos_train_step(tick)
+        if self.watchdog is not None:
+            self.watchdog.note_step(tick)  # may raise TrainingStalledError
+            self.watchdog.maybe_heartbeat(tick)
         if self.handler.sentinel == "off":
             return None
         pending, self._pending_metrics = self._pending_metrics, None
         if isinstance(metrics, dict):
-            self._pending_metrics = (metrics.get("loss"), metrics.get("grad_norm"), slot)
+            if poison:
+                self._pending_metrics = (float("nan"), float("nan"), slot)
+            else:
+                self._pending_metrics = (metrics.get("loss"), metrics.get("grad_norm"), slot)
         if pending is None:
             return None
         loss_arr, gnorm_arr, p_slot = pending
@@ -650,6 +1068,9 @@ class FaultToleranceManager:
             )
         # policy == "rollback"
         if self.rollbacks_done >= self.handler.max_rollbacks:
+            # DivergenceError.exit_code is POISONED_CHECKPOINT_EXIT_CODE: a
+            # supervised script exiting with it tells the launch supervisor
+            # NOT to relaunch — the checkpoint reproduces the divergence.
             raise DivergenceError(
                 f"training diverged again ({reason}) after "
                 f"{self.rollbacks_done} rollback(s) — max_rollbacks "
@@ -682,5 +1103,7 @@ class FaultToleranceManager:
         return new_state
 
     def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.uninstall_signal_handlers()
         self._pending_metrics = None

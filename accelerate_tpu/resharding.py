@@ -272,6 +272,33 @@ def raise_topology_mismatch(manifest: dict, n_devices: int, layout: Optional[dic
     )
 
 
+def shrink_world_size(current: int, lost: int = 1, layout: Optional[dict] = None) -> Optional[int]:
+    """The world size the launch supervisor should relaunch at after losing
+    ``lost`` host(s) to repeated dead-host exits (commands/launch.py).
+
+    With a recorded layout (a plan artifact's, or the run's parallelism
+    config), the answer is the largest size at or below ``current - lost``
+    the planner validates via :func:`planner.scaled_layout` — i.e. the
+    model-parallel axes still divide it, so the elastic resume reshards
+    instead of re-searching. Without one, the largest power of two at or
+    below the target, which keeps dp sharding even on any checkpoint.
+    Returns None when no viable smaller size exists."""
+    target = int(current) - max(1, int(lost))
+    if target < 1:
+        return None
+    if layout:
+        from .planner import scaled_layout
+
+        for n in range(target, 0, -1):
+            if scaled_layout(layout, n) is not None:
+                return n
+        return None
+    n = 1
+    while n * 2 <= target:
+        n *= 2
+    return n
+
+
 # ----------------------------------------------------------------------
 # Transfer planning
 # ----------------------------------------------------------------------

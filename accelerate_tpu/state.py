@@ -264,6 +264,24 @@ class PartialState:
         total = reduce(jnp.asarray(1 if flag else 0, jnp.int32), reduction="sum")
         return int(np.asarray(total)) > 0
 
+    def allgather_host_floats(self, values) -> "np.ndarray":
+        """Allgather a small host-side float vector across ranks, returning
+        a ``(num_processes, len(values))`` numpy array (row r = rank r's
+        vector). Single-process returns the ``(1, n)`` input. The
+        rank-coherence channel behind the step watchdog's gang heartbeat
+        (fault_tolerance.py) — same family as :meth:`agree_any`: one tiny
+        collective, every rank sees the same table and takes the same
+        decision."""
+        import numpy as np
+
+        vec = np.asarray(values, np.float64).reshape(1, -1)
+        if self.num_processes <= 1:
+            return vec
+        from .utils.operations import gather
+
+        out = np.asarray(gather(vec), np.float64)
+        return out.reshape(self.num_processes, -1)
+
     @contextmanager
     def main_process_first(self):
         """Main process runs the body first, others wait then run

@@ -159,6 +159,17 @@ class TelemetryRecorder:
             "fallback_saves": 0,
             "async_errors": 0,
         }
+        # Injected-fault + watchdog tallies (fed by record_event; surfaced
+        # as the summary's "faults"/"watchdog" blocks so bench training rows
+        # grade robustness runs — the training twin of the serving engines'
+        # faults block).
+        self._faults = {"injected": 0, "by_site": {}}
+        self._watchdog = {
+            "warnings": 0,
+            "stalls": 0,
+            "last_straggler": None,
+            "last_ages_s": None,
+        }
         # Serving block (serving.py): per-request TTFT/TPOT events stream as
         # they retire; the engine pushes its aggregate summary via
         # record_serving and it rides the summary as the "serving" block.
@@ -455,6 +466,19 @@ class TelemetryRecorder:
             ck["async_errors"] += 1
         elif event == "serving_request_done":
             self._serving_requests += 1
+        elif event == "fault_injected":
+            self._faults["injected"] += 1
+            site = f"{fields.get('point')}:{fields.get('kind')}"
+            by = self._faults["by_site"]
+            by[site] = by.get(site, 0) + 1
+        elif event == "training_stalled":
+            wd = self._watchdog
+            if fields.get("level") == "stall":
+                wd["stalls"] += 1
+            else:
+                wd["warnings"] += 1
+            wd["last_straggler"] = fields.get("straggler")
+            wd["last_ages_s"] = fields.get("ages_s")
         record = {"event": event, "step": self.step, "time": time.time()}
         record.update(fields)
         self._write(record)
@@ -643,6 +667,23 @@ class TelemetryRecorder:
                 for k, v in self._ckpt.items()
             },
         }
+        ft = getattr(self.accelerator, "fault_tolerance", None)
+        if ft is not None and ft.chaos is not None:
+            # Injected-fault census straight from the injector — the
+            # authoritative ordered log (chaos.py), not just the events this
+            # recorder happened to see.
+            out["faults"] = ft.chaos.summary()
+        elif self._faults["injected"]:
+            out["faults"] = {
+                "injected": self._faults["injected"],
+                "by_site": dict(sorted(self._faults["by_site"].items())),
+            }
+        if ft is not None and ft.watchdog is not None:
+            # Stall-detection ladder counts + last per-rank ages
+            # (fault_tolerance.py StepWatchdog).
+            out["watchdog"] = ft.watchdog.summary()
+        elif self._watchdog["warnings"] or self._watchdog["stalls"]:
+            out["watchdog"] = dict(self._watchdog)
         if self._serving_summary is not None:
             # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py):
             # bench rows embed it like the checkpoint/compile blocks.

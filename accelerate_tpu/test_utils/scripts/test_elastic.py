@@ -8,6 +8,13 @@ and CONTINUE from step 3 instead of silently retraining from scratch.
 
 Reference analog: torch elastic max_restarts (launch.py:998-1030) plus the
 script-side resume_from_checkpoint idiom — here the resume is framework-owned.
+
+With ELASTIC_CHAOS=dead_host the hand-rolled failure is replaced by a chaos
+``dead_host`` injection: every rank draws the same scheduled fault at the
+4th step's observe and dies with the SIGSEGV-style code 139, so the launcher
+sees exactly what a segfaulting host looks like. The supervisor must classify
+it dead-host, back off, relaunch, and attempt 1 must resume from the newest
+verified checkpoint — the same assertions as the manual-kill path.
 """
 import os
 import sys
@@ -23,14 +30,37 @@ from accelerate_tpu.utils import ProjectConfiguration, set_seed
 
 work = os.environ["ELASTIC_TEST_DIR"]
 attempt = int(os.environ.get("ACCELERATE_RESTART_ATTEMPT", "0") or 0)
+chaos_mode = os.environ.get("ELASTIC_CHAOS", "") == "dead_host"
+
+TOTAL, FAIL_AFTER = 6, 3
 
 set_seed(0)
+handlers = []
+if chaos_mode:
+    from accelerate_tpu.utils import FaultToleranceKwargs
+
+    # No "unit": the entry matches every rank, so the whole gang dies at
+    # tick FAIL_AFTER (the 4th step's observe — steps 1..3 are already
+    # checkpointed) and no survivor is left hanging on a collective. The
+    # schedule stays armed on attempt 1 too: the resumed run only observes
+    # 3 more steps (ticks 0..2), so the fault never re-fires.
+    handlers.append(
+        FaultToleranceKwargs(
+            chaos=dict(
+                seed=0,
+                schedule=[
+                    {"point": "host_heartbeat", "kind": "dead_host", "tick": FAIL_AFTER}
+                ],
+            )
+        )
+    )
 acc = Accelerator(
     project_config=ProjectConfiguration(
         project_dir=work,
         automatic_checkpoint_naming=True,
         automatic_resume=True,
-    )
+    ),
+    kwargs_handlers=handlers,
 )
 rank, world = acc.process_index, acc.num_processes
 assert world > 1
@@ -52,13 +82,12 @@ else:
     # Numbering continues past the restored checkpoint — no clobbering.
     assert acc.project_configuration.iteration == 3
 
-TOTAL, FAIL_AFTER = 6, 3
 state = acc.train_state
 for i in range(start, TOTAL):
     state, _ = step_fn(state, batch)
     acc._train_state = state
     acc.save_state()
-    if attempt == 0 and i + 1 == FAIL_AFTER:
+    if attempt == 0 and not chaos_mode and i + 1 == FAIL_AFTER:
         acc.wait_for_everyone()  # every rank's checkpoint write is done
         if rank == world - 1:
             print(f"[elastic] rank {rank} simulating hardware failure", flush=True)

@@ -63,3 +63,12 @@ PLAN_MANIFEST_NAME = "plan_manifest.json"
 # launch gang loop treats it as "resumable — relaunch with
 # ACCELERATE_RESTART_ATTEMPT+1" instead of a crash.
 PREEMPTION_EXIT_CODE = 75
+# Exit code the step watchdog's self-preempt escalation hard-exits with when
+# the loop is too stuck to take the SIGTERM save path (fault_tolerance.py
+# StepWatchdog). The launch supervisor classifies it "stalled" — resumable
+# from the newest verified checkpoint, counted against the restart budget.
+TRAINING_STALLED_EXIT_CODE = 76
+# Exit code for "the divergence is reproducible from the checkpoint"
+# (DivergenceError after max_rollbacks). The supervisor refuses to relaunch:
+# the same checkpoint feeds the same divergence, so a restart would thrash.
+POISONED_CHECKPOINT_EXIT_CODE = 77

@@ -333,9 +333,34 @@ class FaultToleranceKwargs(KwargsHandler):
       ``max_rollbacks`` times) and re-primes RNG/dataloader state so the run
       resumes deterministically. ``"off"`` disables the watch entirely.
 
+    Two more pillars ride on the same manager (default off):
+
+    - **Chaos injection** (``chaos``): a
+      :class:`~accelerate_tpu.chaos.FaultInjector` (or its constructor
+      kwargs as a dict) drives deterministic training-side faults —
+      ``train_step``/``nonfinite_grad``/``slow_step``,
+      ``checkpoint_save``/``torn_write``, ``dataloader_batch``/
+      ``corrupt_batch``, ``host_heartbeat``/``dead_host`` — through the
+      SAME recovery paths real failures take (sentinel → rollback, save
+      retry → fallback, exit → gang relaunch). ``None`` (default) keeps
+      every hook a single ``None`` check.
+    - **Step watchdog** (``watchdog``): a host-side thread + lagged
+      per-step notes detecting a progress-free or straggling gang. A step
+      older than ``watchdog_warn_s`` emits a ``training_stalled`` telemetry
+      event (per-rank last-step ages, straggler named); past
+      ``watchdog_stall_s`` the policy escalates — ``"warn"`` keeps logging,
+      ``"error"`` raises :class:`~accelerate_tpu.fault_tolerance.
+      TrainingStalledError` at the next completed step, ``"preempt"``
+      self-preempts (SIGTERM → preemption save if the loop is alive, then
+      hard-exits ``TRAINING_STALLED_EXIT_CODE`` after a grace period so the
+      supervisor relaunches from the newest verified checkpoint). With
+      ``watchdog_heartbeat_every`` > 0 and a multi-process gang, every N
+      steps the ranks allgather (step, age) over the ``agree_any``-style
+      channel so a stalled PEER is detected and named too.
+
     All events (save retries, torn checkpoints skipped, preemption saves,
-    rollbacks) flow into the telemetry JSONL when a
-    :class:`TelemetryKwargs` handler is also present.
+    rollbacks, injected faults, stall warnings) flow into the telemetry
+    JSONL when a :class:`TelemetryKwargs` handler is also present.
     """
 
     enabled: bool = True
@@ -353,6 +378,13 @@ class FaultToleranceKwargs(KwargsHandler):
     sentinel_explode_factor: float = 10.0
     sentinel_ema_alpha: float = 0.1
     max_rollbacks: int = 2
+    chaos: Optional[object] = None  # FaultInjector | dict of its kwargs
+    watchdog: str = "off"  # off | warn | error | preempt
+    watchdog_warn_s: float = 60.0
+    watchdog_stall_s: float = 300.0
+    watchdog_poll_s: float = 1.0
+    watchdog_heartbeat_every: int = 0  # steps between gang heartbeats (0 off)
+    watchdog_grace_s: float = 30.0  # preempt policy: SIGTERM → hard-exit gap
 
     def __post_init__(self):
         if self.checksum not in ("sha256", "size"):
@@ -361,6 +393,19 @@ class FaultToleranceKwargs(KwargsHandler):
             raise ValueError("sentinel must be off|warn|halt|rollback")
         if self.sentinel_window < 1:
             raise ValueError("sentinel_window must be >= 1")
+        if self.watchdog not in ("off", "warn", "error", "preempt"):
+            raise ValueError("watchdog must be off|warn|error|preempt")
+        if self.watchdog_warn_s <= 0 or self.watchdog_stall_s <= 0:
+            raise ValueError("watchdog_warn_s/watchdog_stall_s must be > 0")
+        if self.watchdog_stall_s < self.watchdog_warn_s:
+            raise ValueError(
+                "watchdog_stall_s must be >= watchdog_warn_s (warn first, "
+                "then escalate)"
+            )
+        if self.watchdog_poll_s <= 0:
+            raise ValueError("watchdog_poll_s must be > 0")
+        if self.watchdog_heartbeat_every < 0:
+            raise ValueError("watchdog_heartbeat_every must be >= 0")
 
 
 @dataclass

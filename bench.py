@@ -349,6 +349,21 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                           "measured_peak_hbm_gib", "hbm_ratio", "calibrated",
                           "mfu_effective")
             }
+        # Training-chaos block (fault_tolerance.py via flush_telemetry):
+        # injected-fault and step-watchdog counters ride along so a
+        # chaos-enabled bench round shows its fault/stall activity next to
+        # the step times it perturbed.
+        if t.get("faults"):
+            result["telemetry"]["faults"] = {
+                k: t["faults"].get(k) for k in ("injected", "by_site")
+            }
+        if t.get("watchdog"):
+            wd = t["watchdog"]
+            result["telemetry"]["watchdog"] = {
+                k: wd.get(k)
+                for k in ("policy", "warnings", "stalls", "escalations",
+                          "straggler_events", "heartbeats")
+            }
         # Serving block (TTFT/TPOT/occupancy/tokens-per-s — serving.py via
         # telemetry.record_serving): rows carry it like the checkpoint and
         # compile blocks so serving-throughput regressions show up in the

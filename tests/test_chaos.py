@@ -124,8 +124,48 @@ def test_injector_validation():
     # Scalar rate shorthand takes the point's first legal kind.
     chaos = FaultInjector(seed=1, rates={"prefill_dispatch": 1.0})
     assert chaos.draw("prefill_dispatch", 0).kind == "transfer_error"
-    assert set(INJECTION_POINTS) == {"prefill_dispatch", "decode_tick",
-                                     "handoff_device_put", "lane_health"}
+    assert set(INJECTION_POINTS) == {
+        # serving
+        "prefill_dispatch", "decode_tick", "handoff_device_put", "lane_health",
+        # training
+        "train_step", "collective_op", "checkpoint_save", "dataloader_batch",
+        "host_heartbeat",
+    }
+
+
+def test_training_points_and_extras():
+    """Training-side points: kind legality, schedule pass-through fields on
+    Fault.extra, slow_step_s validation, and the point-name-keyed hash —
+    adding the training points must not have moved any serving schedule."""
+    with pytest.raises(ValueError):
+        FaultInjector(rates={"train_step": {"torn_write": 0.1}})  # wrong point
+    with pytest.raises(ValueError):
+        FaultInjector(slow_step_s=-1.0)
+    chaos = FaultInjector(seed=4, schedule=[
+        {"point": "train_step", "kind": "slow_step", "tick": 2, "seconds": 0.5},
+        {"point": "host_heartbeat", "kind": "dead_host", "tick": 3, "unit": 1,
+         "exit_code": 77},
+        {"point": "checkpoint_save", "kind": "torn_write", "tick": 0},
+    ])
+    f = chaos.draw("train_step", 2)
+    assert f.kind == "slow_step" and f.extra == {"seconds": 0.5}
+    assert chaos.draw("host_heartbeat", 3, unit=0) is None  # wrong rank
+    f = chaos.draw("host_heartbeat", 3, unit=1)
+    assert f.kind == "dead_host" and f.extra == {"exit_code": 77}
+    f = chaos.draw("checkpoint_save", 0, unit=0)
+    assert f.kind == "torn_write" and f.extra is None
+    # Rate-driven training faults carry no extra.
+    rated = FaultInjector(seed=4, rates={"train_step": 1.0})
+    f = rated.draw("train_step", 0)
+    assert f.kind == "nonfinite_grad" and f.extra is None  # first legal kind
+    # Point-name keying: a serving-point draw grid is identical whether or
+    # not training rates exist on the same injector.
+    a = FaultInjector(seed=9, rates={"decode_tick": {"poison": 0.3}})
+    b = FaultInjector(seed=9, rates={"decode_tick": {"poison": 0.3},
+                                     "train_step": {"slow_step": 0.5}})
+    grid = [(t, u) for t in range(40) for u in range(2)]
+    assert [a.draw("decode_tick", t, u) for t, u in grid] == \
+           [b.draw("decode_tick", t, u) for t, u in grid]
 
 
 def test_deterministic_jitter():

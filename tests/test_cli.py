@@ -280,6 +280,140 @@ def test_elastic_restart_recovers(tmp_path):
     assert "attempt=1 rank=0 ok" in r.stdout
 
 
+def test_classify_exit_table():
+    """The supervisor's failure classes, pinned (commands/launch.py)."""
+    import signal as _signal
+
+    from accelerate_tpu.commands.launch import classify_exit
+
+    assert classify_exit(0) == "ok"
+    assert classify_exit(130) == "interrupted"
+    assert classify_exit(-_signal.SIGINT) == "interrupted"
+    assert classify_exit(75) == "preempted"  # PREEMPTION_EXIT_CODE
+    assert classify_exit(76) == "stalled"  # TRAINING_STALLED_EXIT_CODE
+    assert classify_exit(77) == "poisoned"  # POISONED_CHECKPOINT_EXIT_CODE
+    assert classify_exit(137) == "oom"
+    assert classify_exit(-_signal.SIGKILL) == "oom"
+    assert classify_exit(139) == "dead-host"  # chaos dead_host default
+    assert classify_exit(-_signal.SIGSEGV) == "dead-host"
+    assert classify_exit(134) == "dead-host"  # 128 + SIGABRT
+    assert classify_exit(1) == "fatal"
+    assert classify_exit(17) == "fatal"
+
+
+def test_restart_backoff_deterministic_and_capped():
+    from accelerate_tpu.commands.launch import _backoff_s
+
+    # Replayable: no RNG, same inputs -> same sleep.
+    assert _backoff_s(2, 1.0, 30.0) == _backoff_s(2, 1.0, 30.0)
+    # Exponential until the cap; jitter stays within +-25%.
+    for n in range(8):
+        d = _backoff_s(n, 1.0, 30.0)
+        raw = min(30.0, 2.0**n)
+        assert 0.75 * raw <= d <= 1.25 * raw
+    assert _backoff_s(3, 0.0, 30.0) == 0.0
+
+
+def test_supervisor_budget_poisoned_and_preempted():
+    from accelerate_tpu.commands.launch import GangSupervisor
+
+    sup = GangSupervisor(max_restarts=1, backoff_s=0.5)
+    d = sup.decide(139, uptime_s=5.0, num_processes=4)
+    assert d.action == "restart" and d.classification == "dead-host"
+    assert d.delay_s > 0
+    d = sup.decide(139, uptime_s=5.0, num_processes=4)
+    assert d.action == "stop" and "budget exhausted" in d.reason
+
+    # Preempted workers saved on the way out: relaunch immediately.
+    sup = GangSupervisor(max_restarts=3)
+    d = sup.decide(75, uptime_s=100.0, num_processes=4)
+    assert d.action == "restart" and d.classification == "preempted"
+    assert d.delay_s == 0.0
+
+    # A poisoned checkpoint replays the same divergence — never relaunch,
+    # even with budget left.
+    d = sup.decide(77, uptime_s=100.0, num_processes=4)
+    assert d.action == "refuse" and d.classification == "poisoned"
+
+    d = GangSupervisor(max_restarts=3).decide(0, uptime_s=10.0, num_processes=4)
+    assert d.action == "stop" and d.classification == "ok"
+
+
+def test_supervisor_refuses_deterministic_fatal():
+    from accelerate_tpu.commands.launch import GangSupervisor
+
+    # The same fatal rc twice in quick succession is a deterministic crash.
+    sup = GangSupervisor(max_restarts=10)
+    assert sup.decide(17, uptime_s=2.0, num_processes=4).action == "restart"
+    d = sup.decide(17, uptime_s=2.0, num_processes=4)
+    assert d.action == "refuse" and "deterministic" in d.reason
+
+    # A slow crash between them breaks the streak (it made progress).
+    sup = GangSupervisor(max_restarts=10)
+    assert sup.decide(17, uptime_s=2.0, num_processes=4).action == "restart"
+    assert sup.decide(17, uptime_s=600.0, num_processes=4).action == "restart"
+    assert sup.decide(17, uptime_s=2.0, num_processes=4).action == "restart"
+
+
+def test_supervisor_dead_host_shrink():
+    from accelerate_tpu.commands.launch import GangSupervisor
+
+    sup = GangSupervisor(max_restarts=10, backoff_s=0.0, shrink_after=2)
+    d = sup.decide(139, uptime_s=5.0, num_processes=8)
+    assert d.action == "restart" and d.num_processes is None
+    d = sup.decide(-11, uptime_s=5.0, num_processes=8)  # second dead host
+    assert d.action == "restart" and d.num_processes == 4  # pow2 below 8-1
+    # The streak reset: the next dead host starts counting again.
+    d = sup.decide(139, uptime_s=5.0, num_processes=4)
+    assert d.num_processes is None
+    # A planner layout constrains the shrink to validated sizes.
+    sup = GangSupervisor(
+        max_restarts=10, backoff_s=0.0, shrink_after=1,
+        layout={"tp": 2, "dp_shard": 4},
+    )
+    d = sup.decide(139, uptime_s=5.0, num_processes=8)
+    assert d.num_processes == 6  # tp=2 must still divide: 6 = 3x2 works
+
+
+def test_shrink_world_size():
+    from accelerate_tpu.resharding import shrink_world_size
+
+    assert shrink_world_size(8) == 4  # largest pow2 <= 7
+    assert shrink_world_size(9) == 8
+    assert shrink_world_size(2) == 1
+    assert shrink_world_size(1) is None
+    assert shrink_world_size(8, lost=7) == 1
+    assert shrink_world_size(8, layout={"tp": 4, "dp_shard": 2}) == 4
+    assert shrink_world_size(4, lost=1, layout={"tp": 4}) is None
+
+
+def test_launched_dead_host_chaos_supervisor(tmp_path):
+    """Satellite of the chaos-training pillar: a chaos-injected dead_host
+    (exit 139 on every rank at the 4th step) must be classified dead-host by
+    the supervisor, relaunched with backoff, and attempt 1 must resume from
+    the newest verified checkpoint (assertions inside test_elastic.py)."""
+    import subprocess
+    import sys as _sys
+
+    from accelerate_tpu.test_utils import get_launch_command
+
+    cmd = get_launch_command(
+        num_processes=2, virtual_devices=2, max_restarts=1,
+        restart_backoff=0.05,
+    ) + ["-m", "accelerate_tpu.test_utils.scripts.test_elastic"]
+    r = subprocess.run(
+        cmd,
+        env={**os.environ, "PYTHONPATH": os.getcwd(),
+             "ELASTIC_TEST_DIR": str(tmp_path),
+             "ELASTIC_CHAOS": "dead_host"},
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "Elastic resume test passed" in r.stdout
+    assert "rc=139, dead-host" in r.stderr
+    assert "restarting gang" in r.stderr
+
+
 def test_convert_config_fsdp(tmp_path, capsys):
     """Reference FSDP yaml → our LaunchConfig yaml (to-fsdp2 migration role)."""
     import yaml

@@ -602,3 +602,237 @@ def test_kwargs_validation():
         FaultToleranceKwargs(sentinel="panic")
     with pytest.raises(ValueError):
         FaultToleranceKwargs(sentinel_window=0)
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(watchdog="panic")
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(watchdog_warn_s=0)
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(watchdog_warn_s=10.0, watchdog_stall_s=5.0)
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(watchdog_poll_s=0)
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(watchdog_heartbeat_every=-1)
+
+
+# ---------------------------------------------------------------------------
+# Chaos injection (training side) + step watchdog
+# ---------------------------------------------------------------------------
+
+
+class _FakeAcc:
+    """The slice of Accelerator the manager's host-side hooks touch."""
+
+    process_index = 0
+    num_processes = 1
+    step = 0
+    telemetry = None
+
+
+def _manager(**kw):
+    from accelerate_tpu.fault_tolerance import FaultToleranceManager
+    from accelerate_tpu.state import PartialState
+
+    PartialState()  # the manager's logger requires an initialized state
+    return FaultToleranceManager(_FakeAcc(), _ft(**kw))
+
+
+def test_chaos_from_dict_and_nonfinite_grad_poisons_metrics_only():
+    """A chaos dict builds a FaultInjector; nonfinite_grad poisons the
+    SENTINEL's lagged sample (never model state) and counts as injected."""
+    ft = _manager(
+        sentinel="warn", sentinel_window=1,
+        chaos=dict(seed=1, schedule=[
+            {"point": "train_step", "kind": "nonfinite_grad", "tick": 0}]),
+    )
+    from accelerate_tpu.chaos import FaultInjector
+
+    assert isinstance(ft.chaos, FaultInjector)
+    good = {"loss": np.float32(1.0), "grad_norm": np.float32(0.5)}
+    assert ft.observe_step(good) is None  # tick 0: poisoned pending
+    assert ft.faults_injected == 1
+    assert ft.observe_step(good) is None  # lagged fetch sees the NaN
+    assert ft.sentinel.episode_warned  # the sentinel tripped on the poison
+    assert ft.observe_step(good) is None
+    assert ft.faults_injected == 1  # one-shot schedule never re-fires
+
+
+def test_chaos_ticks_monotonic_not_step():
+    """Chaos ticks count observe calls, never the training step — a
+    rollback rewinds the step but must not re-fire an injected fault."""
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "train_step", "kind": "nonfinite_grad", "tick": 1}]))
+    m = {"loss": np.float32(1.0), "grad_norm": np.float32(0.5)}
+    for _ in range(4):  # the fake accelerator's step never advances
+        ft.observe_step(m)
+    assert ft._step_ticks == 4
+    assert ft.faults_injected == 1
+
+
+def test_chaos_slow_step_sleeps():
+    import time as _time
+
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "train_step", "kind": "slow_step", "tick": 0,
+         "seconds": 0.12}]))
+    m = {"loss": np.float32(1.0), "grad_norm": np.float32(0.5)}
+    t0 = _time.monotonic()
+    ft.observe_step(m)
+    assert _time.monotonic() - t0 >= 0.12
+    t0 = _time.monotonic()
+    ft.observe_step(m)  # no fault: no delay
+    assert _time.monotonic() - t0 < 0.1
+
+
+def test_chaos_torn_write_drives_save_retry(tmp_path):
+    """An injected torn_write raises inside the retry loop, per (save,
+    attempt): the first attempt tears, the second commits."""
+    ft = _manager(
+        save_retries=2, retry_backoff_s=0.01, retry_backoff_max_s=0.02,
+        chaos=dict(seed=1, schedule=[
+            {"point": "checkpoint_save", "kind": "torn_write",
+             "tick": 0, "unit": 0}]),
+    )
+    calls = []
+
+    def do_save(target):
+        calls.append(target)
+        os.makedirs(target, exist_ok=True)
+        return target
+
+    out = ft.run_save_with_retry(do_save, str(tmp_path / "ck"))
+    assert out == str(tmp_path / "ck") and len(calls) == 1
+    assert ft.save_retries_total == 1 and ft.faults_injected == 1
+    # The next save draws a fresh tick — clean.
+    out2 = ft.run_save_with_retry(do_save, str(tmp_path / "ck2"))
+    assert out2 == str(tmp_path / "ck2")
+    assert ft.save_retries_total == 1
+
+
+def test_chaos_dead_host_exits_with_chosen_code(monkeypatch):
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "host_heartbeat", "kind": "dead_host", "tick": 0,
+         "exit_code": 91}]))
+
+    class _Exit(BaseException):
+        pass
+
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        raise _Exit()
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    with pytest.raises(_Exit):
+        ft.observe_step({"loss": np.float32(1.0)})
+    assert codes == [91]
+    assert ft.faults_injected == 1
+
+
+def test_chaos_dead_host_rank_targeting(monkeypatch):
+    """A unit-pinned dead_host entry only kills the named rank."""
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "host_heartbeat", "kind": "dead_host", "unit": 3}]))
+    monkeypatch.setattr(
+        os, "_exit", lambda code: (_ for _ in ()).throw(AssertionError))
+    for _ in range(5):  # rank 0 never matches unit 3
+        ft.observe_step({"loss": np.float32(1.0)})
+    assert ft.faults_injected == 0
+
+
+def test_draw_batch_fault_monotonic():
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "dataloader_batch", "kind": "corrupt_batch", "tick": 1}]))
+    assert ft.draw_batch_fault() is None
+    f = ft.draw_batch_fault()
+    assert f is not None and f.kind == "corrupt_batch"
+    assert ft.draw_batch_fault() is None
+    assert ft._batch_ticks == 3
+    # No injector armed: the hook is a cheap None.
+    assert _manager().draw_batch_fault() is None
+
+
+def test_watchdog_warn_policy_records_straggler():
+    import time as _time
+
+    ft = _manager(watchdog="warn", watchdog_warn_s=0.05,
+                  watchdog_stall_s=0.15, watchdog_poll_s=0.01)
+    ft.start_watchdog()
+    try:
+        ft.observe_step({"loss": np.float32(1.0)})
+        _time.sleep(0.3)  # well past stall_s: warn once, stall once
+        wd = ft.watchdog
+        assert wd.warnings >= 1 and wd.stalls >= 1
+        assert wd.escalations == 0  # policy warn never escalates
+        # A completed step re-arms the episode.
+        ft.observe_step({"loss": np.float32(1.0)})
+        assert wd.age() < 0.05
+        s = wd.summary()
+        assert s["policy"] == "warn" and s["last_ages_s"] is not None
+        assert 0 in {int(r) for r in s["last_ages_s"]}  # straggler named
+    finally:
+        ft.close()
+
+
+def test_watchdog_error_policy_raises_at_next_step():
+    import time as _time
+
+    from accelerate_tpu.fault_tolerance import TrainingStalledError
+    from accelerate_tpu.utils.constants import TRAINING_STALLED_EXIT_CODE
+
+    ft = _manager(watchdog="error", watchdog_warn_s=0.03,
+                  watchdog_stall_s=0.08, watchdog_poll_s=0.01)
+    ft.start_watchdog()
+    try:
+        ft.observe_step({"loss": np.float32(1.0)})
+        _time.sleep(0.25)
+        with pytest.raises(TrainingStalledError, match="stalled") as ei:
+            ft.observe_step({"loss": np.float32(1.0)})
+        assert ei.value.exit_code == TRAINING_STALLED_EXIT_CODE
+        assert ei.value.straggler == 0 and 0 in ei.value.ages
+    finally:
+        ft.close()
+
+
+def test_watchdog_preempt_policy_sigterms_self():
+    import time as _time
+
+    ft = _manager(watchdog="preempt", watchdog_warn_s=0.03,
+                  watchdog_stall_s=0.08, watchdog_poll_s=0.01,
+                  watchdog_grace_s=60.0)
+    ft.install_signal_handlers()
+    ft.start_watchdog()
+    try:
+        ft.observe_step({"loss": np.float32(1.0)})
+        deadline = _time.monotonic() + 2.0
+        while not ft.preempted and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        # The watchdog SIGTERM'd this process; the preemption handler
+        # latched the flag — the loop would now take a final save and exit
+        # with the resumable code, exactly like a real preemption.
+        assert ft.preempted and ft.preemption_signal == "SIGTERM"
+        assert ft.watchdog.escalations == 1
+    finally:
+        ft.close()
+
+
+def test_watchdog_off_by_default():
+    ft = _manager()
+    assert ft.watchdog is None and ft.chaos is None
+    ft.start_watchdog()  # harmless no-op
+    ft.close()
+
+
+def test_allgather_host_floats_single_process():
+    from accelerate_tpu.state import PartialState
+
+    table = PartialState().allgather_host_floats([3.0, 0.25])
+    assert table.shape == (1, 2)
+    np.testing.assert_allclose(np.asarray(table[0]), [3.0, 0.25])
+
+
+def test_divergence_error_exit_code():
+    from accelerate_tpu.fault_tolerance import DivergenceError
+    from accelerate_tpu.utils.constants import POISONED_CHECKPOINT_EXIT_CODE
+
+    assert DivergenceError("x").exit_code == POISONED_CHECKPOINT_EXIT_CODE
