@@ -7,7 +7,7 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
-        reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke
+        reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -125,6 +125,19 @@ chaos-smoke:
 # docs/usage_guides/fault_tolerance.md "Training under fire".
 chaos-train-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.chaos_train_smoke
+
+# Weight-publication gate: a training run commits verified checkpoints
+# (steps 3 and 5) while a live engine drains a deterministic Poisson trace
+# in the same process; the WeightPublisher hot-swaps both — a canary
+# promote, then a seeded canary_window/slo_regression rollback that stays
+# quarantined. Zero dropped/shed/failed requests across both swaps, ONE
+# decode executable with 0 steady recompiles, version tags flip only
+# post-swap (v0 rows bit-equal to a publish-free reference), the
+# post-rollback probe is bit-equal to loading checkpoint 3 directly, and a
+# second seeded run replays the whole thing bit-identically. See
+# docs/usage_guides/serving.md "Continuous deployment".
+publish-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.publish_smoke
 
 # Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
 # search must be deterministic (byte-identical JSON), every candidate must

@@ -84,6 +84,7 @@ from .generation import (  # noqa: E402
 )
 from .serving import ServingEngine, ServingStalledError, replay_trace  # noqa: E402
 from .disagg import DisaggServingEngine  # noqa: E402
+from .publish import PublishConfig, WeightPublisher  # noqa: E402
 from .chaos import Fault, FaultInjector, InjectedFaultError  # noqa: E402
 from .utils.dataclasses import (  # noqa: E402
     AutoPlanKwargs,

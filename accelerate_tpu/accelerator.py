@@ -1734,6 +1734,27 @@ class Accelerator:
             fault_tolerance=self.fault_tolerance, chaos=chaos,
         )
 
+    def build_weight_publisher(self, engine, config=None, *, chaos=None):
+        """Construct a :class:`~accelerate_tpu.publish.WeightPublisher` that
+        watches this (or another) run's checkpoint directory and hot-swaps
+        verified weights into ``engine`` (a live
+        :class:`~accelerate_tpu.serving.ServingEngine`) with zero downtime:
+        only committed, hash-verified checkpoints are publishable, the
+        train→serve topology gap is bridged through the resharding executor,
+        and new versions roll out through a canary cohort with SLO
+        auto-rollback (see :mod:`accelerate_tpu.publish`).
+
+        ``config`` is a :class:`~accelerate_tpu.publish.PublishConfig`;
+        ``chaos`` defaults to the engine's injector so a single seeded
+        schedule covers serving and publication faults together."""
+        from .publish import WeightPublisher
+
+        if chaos is None:
+            chaos = getattr(engine, "chaos", None)
+        return WeightPublisher(
+            engine, config, chaos=chaos, telemetry=self.telemetry,
+        )
+
     def _comm_hook_step(
         self,
         loss_fn,

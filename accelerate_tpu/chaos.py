@@ -62,6 +62,21 @@ Training fault kinds:
   ``exit_code``, default :data:`DEAD_HOST_DEFAULT_EXIT_CODE`), driving the
   launch supervisor's classify → backoff → relaunch path.
 
+Publication injection points (drawn by ``publish.WeightPublisher`` when
+constructed with ``chaos=...``):
+
+- ``publish_manifest`` — the checkpoint-manifest verification gate
+  (``tick`` = publish attempt index, ``unit`` = weights_version);
+  ``torn_write`` makes the manifest read as torn and ``version_mismatch``
+  as stale — either way the checkpoint is skipped and the old version
+  keeps serving;
+- ``publish_transfer`` — the train→serve weight redistribution
+  (``transfer_error``: ``u < 0.75`` transient — one retry heals it — else
+  persistent, exhausting the retry budget and aborting the publish);
+- ``canary_window`` — the canary promote/rollback decision
+  (``slo_regression`` forces the decision to read as a regression, driving
+  the bit-equal auto-rollback path).
+
 Off by default everywhere: no injector exists unless you construct one and
 pass it to an engine (``ServingEngine(..., chaos=...)``) or to
 ``FaultToleranceKwargs(chaos=...)``; the import is lazy-safe (numpy only)
@@ -109,11 +124,16 @@ INJECTION_POINTS = (
     "checkpoint_save",
     "dataloader_batch",
     "host_heartbeat",
+    # weight publication (publish.py)
+    "publish_manifest",
+    "publish_transfer",
+    "canary_window",
 )
 
 FAULT_KINDS = (
     "transfer_error", "delay", "dead_lane", "poison",
     "nonfinite_grad", "slow_step", "torn_write", "corrupt_batch", "dead_host",
+    "slo_regression", "version_mismatch",
 )
 
 # An injected dead host exits 139 (128 + SIGSEGV) unless the schedule entry
@@ -133,6 +153,13 @@ _POINT_KINDS = {
     "checkpoint_save": ("torn_write",),
     "dataloader_batch": ("corrupt_batch",),
     "host_heartbeat": ("dead_host",),
+    # Weight publication (publish.py): a torn/mismatched manifest skips the
+    # checkpoint (old version keeps serving), a transfer error drives the
+    # retry/backoff -> abort-publish path, and an injected SLO regression
+    # forces the canary decision to roll back.
+    "publish_manifest": ("torn_write", "version_mismatch"),
+    "publish_transfer": ("transfer_error",),
+    "canary_window": ("slo_regression",),
 }
 
 _MASK = (1 << 64) - 1

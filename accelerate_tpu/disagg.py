@@ -200,6 +200,10 @@ class DisaggServingEngine(ServingEngine):
             self._state, SlotState(*([vec_s] * len(SlotState._fields))))
         self._params_decode = jax.device_put(model.params, self._decode_sharding)
         self._params = self._params_decode  # what the decode hook dispatches
+        # Version 0's buffers are the decode-mesh copy, not the model's own
+        # placement — keep the publication double-buffer consistent with
+        # what the dispatch hooks actually feed the programs.
+        self._params_by_version[0] = self._params_decode
 
         # -- prefill lanes -------------------------------------------------
         params_by_dev: dict = {}
@@ -220,6 +224,9 @@ class DisaggServingEngine(ServingEngine):
         # request wave strides across every lane (and warmup covers each
         # lane's device with every ladder rung).
         self._free_lanes: deque[_Lane] = deque(self._lanes)
+        # Published versions carry per-prefill-device param copies too (one
+        # per unique lane device, like construction): version -> dev -> tree.
+        self._lane_params: dict[int, dict] = {0: params_by_dev}
 
         # -- the data plane ------------------------------------------------
         self._handoffs: deque[_Handoff] = deque()
@@ -278,7 +285,7 @@ class DisaggServingEngine(ServingEngine):
                 # No live rows: lengths pass through unchanged, k/v garbage
                 # lands where inserts overwrite or attention never reaches.
                 self._cache, self._state, _, _ = self._decode(
-                    self._params, self._cache, self._state)
+                    self._params, self._cache, self._state, self._full_mask)
 
         if _log_ok():
             logger.info(
@@ -365,7 +372,8 @@ class DisaggServingEngine(ServingEngine):
         dc = self.disagg_config
         start = req.consumed  # host-tracked — lane slot 0 IS this request
         lane.cache, lane.state, tok, done0 = self._prefill(
-            lane.params, lane.cache, lane.state, chunk,
+            self._lane_params[req.weights_version][lane.device],
+            lane.cache, lane.state, chunk,
             np.int32(0), np.int32(valid), np.int32(req.budget),
             req.rng, is_first, is_final,
         )
@@ -550,6 +558,24 @@ class DisaggServingEngine(ServingEngine):
         if h.t0 is not None:
             jax.block_until_ready(k_page)
             self._handoff_lat_s.append(time.perf_counter() - h.t0)
+
+    # -- weight publication ------------------------------------------------
+
+    def _install_params(self, params, version: int) -> None:
+        """Disagg placement for a published version: ``params`` (validated
+        against the decode placement — that is what ``_params`` aliases
+        here) becomes the decode-mesh copy, plus one host of per-device
+        copies for the prefill lanes, mirroring construction."""
+        super()._install_params(params, version)
+        by_dev: dict = {}
+        for lane in self._lanes:
+            if lane.device not in by_dev:
+                by_dev[lane.device] = jax.device_put(params, lane.device)
+        self._lane_params[int(version)] = by_dev
+
+    def _drop_params(self, version: int) -> None:
+        super()._drop_params(version)
+        self._lane_params.pop(int(version), None)
 
     # -- warmup ------------------------------------------------------------
 

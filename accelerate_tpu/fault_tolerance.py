@@ -206,6 +206,9 @@ def write_manifest(
     manifest = {
         "version": MANIFEST_VERSION,
         "step": step,
+        # The monotonic guard weight-publication consumers key on (publish.py
+        # refuses stale/duplicate versions) — the train step, when known.
+        "weights_version": int(step) if step is not None else None,
         "world_size": world_size,
         "checksum": checksum,
         "time": time.time(),

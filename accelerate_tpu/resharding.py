@@ -208,8 +208,20 @@ def write_plan_manifest(accelerator, out_dir: str) -> Optional[str]:
             continue
         leaves.update(_leaf_records(train_state, shardings, prefix=f"slot{slot}"))
     plan = getattr(accelerator, "active_plan", None)
+    # Monotonic publication guard: the train step of the first prepared
+    # slot, matching the fault-tolerance manifest's weights_version.
+    weights_version = None
+    for train_state in getattr(accelerator, "_train_states", []) or []:
+        step = getattr(train_state, "step", None)
+        if step is not None:
+            try:
+                weights_version = int(step)
+            except (TypeError, ValueError):
+                weights_version = None
+            break
     manifest = {
         "version": PLAN_MANIFEST_VERSION,
+        "weights_version": weights_version,
         "world_size": int(accelerator.num_processes),
         "n_devices": len(state.devices),
         "layout": layout,

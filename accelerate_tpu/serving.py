@@ -197,14 +197,48 @@ def init_slot_state(n_slots: int, seed: int = 0) -> SlotState:
     )
 
 
+def _commit_params(params):
+    """Pin every device leaf to its current placement (a committed
+    ``device_put`` no-op). The jit dispatch cache keys on commitment, so
+    every installed version must look alike — model-init trees arrive
+    uncommitted while published trees (reshard-executor output) arrive
+    committed, and mixing them would recompile decode at the first swap."""
+    def commit(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.committed:
+            return jax.device_put(leaf, leaf.sharding)
+        return leaf
+
+    return jax.tree.map(commit, params)
+
+
+def _select_keys(mask, a, b):
+    """Per-row key select over typed PRNG key arrays: ``a`` where ``mask``,
+    else ``b``. Goes through key_data because jnp.where on extended dtypes is
+    version-fragile."""
+    data = jnp.where(mask[..., None], jax.random.key_data(a),
+                     jax.random.key_data(b))
+    return jax.random.wrap_key_data(data, impl=jax.random.key_impl(a))
+
+
 def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
     """ONE jitted decode program for the whole engine lifetime: every slot
     advances one token (rows that are free or done compute masked garbage —
     the fixed shape is what buys zero steady-state recompiles). Cache and
-    state buffers are donated."""
+    state buffers are donated; params are NOT (the weight-publication hot
+    swap relies on rebinding them without invalidating live buffers).
 
-    def decode(params, cache: KVCache, state: SlotState):
-        live = state.active & ~state.done
+    ``run_mask`` is a host-side (N,) bool vector selecting which slots this
+    dispatch advances. Steady state passes all-True — one dispatch per tick,
+    bit-identical to the unmasked step. During a canary window the engine
+    dispatches the SAME executable once per weights version with
+    complementary masks, so slots bound to different param versions advance
+    under their own weights: masked-out rows keep their token, length,
+    budget accounting, and PRNG stream frozen (a masked live row's stale
+    cache write at its frozen offset is overwritten by its owning dispatch
+    before attention reads it — the same mechanism that parks done rows)."""
+
+    def decode(params, cache: KVCache, state: SlotState, run_mask):
+        live = state.active & ~state.done & run_mask
         logits, new_cache = fwd(cfg, params, state.last_token[:, None], cache)
         # fwd advanced every row's write offset; only live rows really did.
         lengths = jnp.where(live, new_cache.length, cache.length)
@@ -233,9 +267,10 @@ def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
             done=state.done | newly_done,
             generated=generated,
             budget=state.budget,
-            # Free/done slots' streams are dead until realloc rewrites them,
-            # so advancing every row keeps the update shape-uniform.
-            rng=carry,
+            # Masked rows' streams must freeze (another version's dispatch
+            # owns their advance this tick); free/done slots' streams are
+            # dead until realloc rewrites them either way.
+            rng=_select_keys(live, carry, state.rng),
         )
         return KVCache(new_cache.k, new_cache.v, lengths), new_state, tok, bad
 
@@ -326,7 +361,7 @@ class _Request:
     __slots__ = (
         "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
         "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
-        "deadline", "retries", "status",
+        "deadline", "retries", "status", "weights_version", "canary",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -347,11 +382,14 @@ class _Request:
         self.deadline = None          # absolute perf_counter SLO, or None
         self.retries = 0              # recovery resubmissions consumed
         self.status = None            # terminal: ok | timeout | shed | failed
+        self.weights_version = None   # param version bound at first grant
+        self.canary = False           # admitted inside a canary window
 
     def reset_for_retry(self) -> None:
-        """Back to freshly-queued: prompt, budget, rng, deadline, and the
-        original submit_t survive, so the resubmission is idempotent — the
-        same per-request PRNG stream replays bit-equal output."""
+        """Back to freshly-queued: prompt, budget, rng, deadline, the
+        original submit_t, and the bound weights_version survive, so the
+        resubmission is idempotent — the same per-request PRNG stream under
+        the same param version replays bit-equal output."""
         self.slot = None
         self.lane = None
         self.chunks = None
@@ -440,14 +478,32 @@ class ServingEngine:
         self._prefill = _build_prefill_step(
             fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos
         )
-        self._cache = init_slot_cache(
+        # Cache, slot state, and params all enter the jitted programs
+        # committed in place: the jit cache keys on placement commitment,
+        # and commitment is infectious — with committed params, the cache
+        # the first prefill RETURNS is committed even if the init-time one
+        # was not, which would recompile that chunk size on its second call.
+        # Published versions (device_put through the reshard executor) also
+        # always arrive committed — an uncommitted initial tree would cost
+        # one spurious decode recompile at the first hot swap.
+        self._cache = _commit_params(init_slot_cache(
             self.cfg, self.n_slots, self.t_max, dtype=c.cache_dtype
-        )
-        self._state = init_slot_state(self.n_slots, seed=c.seed)
+        ))
+        self._state = _commit_params(init_slot_state(self.n_slots, seed=c.seed))
         # The param tree the dispatch hooks feed the jitted programs. The
         # disaggregated router (disagg.py) repoints this at the decode-mesh
         # copy; the colocated engine uses the model's own placement.
-        self._params = model.params
+        self._params = _commit_params(model.params)
+        # Weight publication (publish.py): params are double-buffered by
+        # monotonic version. ``_params`` always aliases the PRIMARY version;
+        # in-flight requests keep decoding whatever version they bound at
+        # grant, retired versions are dropped once nothing references them.
+        self._weights_version = 0
+        self._params_by_version = {0: self._params}
+        self._canary = None          # active canary window state, or None
+        self._canary_acc = 0.0       # error-diffusion routing accumulator
+        self._cohorts: dict[int, dict] = {}
+        self._full_mask = np.ones((self.n_slots,), bool)
 
         self._queue: deque[_Request] = deque()
         self._prefilling: deque[_Request] = deque()
@@ -480,6 +536,7 @@ class ServingEngine:
             "sheds": 0, "timeouts": 0, "failed": 0, "retries": 0,
             "slot_quarantines": 0, "lane_quarantines": 0,
             "handoff_retries": 0, "handoff_delays": 0,
+            "promoted": 0, "rolled_back": 0,
         }
         self._quarantined_slots: set[int] = set()
         self._poison_op = None       # lazily jitted chaos-only program
@@ -551,7 +608,10 @@ class ServingEngine:
 
     def poll(self) -> list[dict]:
         """Results finished since the last poll: ``{"id", "status",
-        "tokens", "new_tokens", "ttft_s", "tpot_s"}`` — ``tokens`` is the
+        "tokens", "new_tokens", "ttft_s", "tpot_s", "weights_version"}`` —
+        ``weights_version`` is the param version the request bound at grant
+        (``None`` if it was shed before ever being granted a slot) and
+        ``tokens`` is the
         full prompt+continuation row padded to ``prompt+budget`` with
         ``pad_token_id`` (generate()'s row layout). ``status`` is the
         request's explicit terminal state, one of
@@ -666,6 +726,13 @@ class ServingEngine:
         req.slot = slot
         req.admit_t = time.perf_counter()
         req.chunks = plan_chunks(int(req.tokens.size), self.ladder)
+        if req.weights_version is None or \
+                req.weights_version not in self._params_by_version:
+            # First grant binds a param version (canary routing decides
+            # which); a recovery resubmission keeps its original binding so
+            # the retry replays bit-equal.
+            req.weights_version = self._route_version()
+            req.canary = self._canary is not None
         self._stats["slot_allocs"] += 1
         if slot in self._used_slots:
             self._stats["slot_reuses"] += 1
@@ -718,32 +785,69 @@ class ServingEngine:
         the request's own offset. Returns ``(first_token, done0)`` (device
         scalars; only the final chunk's are fetched)."""
         self._cache, self._state, tok, done0 = self._prefill(
-            self._params, self._cache, self._state, chunk,
-            np.int32(req.slot), np.int32(valid), np.int32(req.budget),
+            self._params_for(req.weights_version), self._cache, self._state,
+            chunk, np.int32(req.slot), np.int32(valid), np.int32(req.budget),
             req.rng, is_first, is_final,
         )
         return tok, done0
+
+    def _decode_groups(self) -> list:
+        """``(version, run_mask)`` dispatch plan for this tick. Steady state
+        (every decoding slot on one version) is a single full-mask dispatch;
+        a mixed-version window (mid-canary, or old requests draining after a
+        swap) dispatches the SAME executable once per version with
+        complementary slot masks."""
+        versions = sorted({r.weights_version for r in self._decoding.values()})
+        if len(versions) <= 1:
+            v = versions[0] if versions else self._weights_version
+            return [(v, self._full_mask)]
+        groups = []
+        for v in versions:
+            mask = np.zeros((self.n_slots,), bool)
+            for slot, r in self._decoding.items():
+                if r.weights_version == v:
+                    mask[slot] = True
+            groups.append((v, mask))
+        return groups
 
     def _decode_tick(self) -> None:
         if self.chaos is not None and self._decoding:
             fault = self.chaos.draw("decode_tick", self._stats["ticks"])
             if fault is not None and fault.kind == "poison":
                 self._poison_slot(min(self._decoding))
-        self._cache, self._state, tok, bad = self._decode(
-            self._params, self._cache, self._state
-        )
         live = len(self._decoding)
-        self._stats["decode_steps"] += 1
-        if self.telemetry is not None:
-            # PR-1 recompile-watchdog cross-check: sample the decode step's
-            # executable cache exactly like a train step's — any mid-flight
-            # growth lands as a "recompile" event in the telemetry JSONL.
-            try:
-                self.telemetry._watch_recompiles(self._decode, tok)
-            except Exception:
-                pass
         self._stats["occupancy_sum"] += live
         self._stats["peak_occupancy"] = max(self._stats["peak_occupancy"], live)
+        for version, mask in self._decode_groups():
+            self._cache, self._state, tok, bad = self._decode(
+                self._params_for(version), self._cache, self._state, mask
+            )
+            self._stats["decode_steps"] += 1
+            if self.telemetry is not None:
+                # PR-1 recompile-watchdog cross-check: sample the decode
+                # step's executable cache exactly like a train step's — any
+                # mid-flight growth lands as a "recompile" event in the
+                # telemetry JSONL.
+                try:
+                    self.telemetry._watch_recompiles(self._decode, tok)
+                except Exception:
+                    pass
+            # The per-tick host sync: fetch this round's tokens + done flags
+            # + the nonfinite sentinel (one fused device_get — no extra
+            # stall). Under a mixed-version tick this runs once per group,
+            # reading only the rows that group's mask advanced.
+            tok_np, done_np, bad_np = jax.device_get(
+                (tok, self._state.done, bad))
+            for slot, req in list(self._decoding.items()):
+                if req.weights_version != version or not mask[slot]:
+                    continue
+                if bool(bad_np[slot]):
+                    self._on_poisoned_slot(slot, req)
+                    continue
+                req.out.append(int(tok_np[slot]))
+                if bool(done_np[slot]):
+                    del self._decoding[slot]
+                    self._retire(req)
         size = _cache_size(self._decode)
         if size is not None:
             if self._decode_executables_baseline is None:
@@ -757,17 +861,6 @@ class ServingEngine:
                     "executable(s)) — the steady state should be exactly one "
                     "program; see docs/usage_guides/serving.md.", extra,
                 )
-        # The per-tick host sync: fetch this round's tokens + done flags +
-        # the nonfinite sentinel (one fused device_get — no extra stall).
-        tok_np, done_np, bad_np = jax.device_get((tok, self._state.done, bad))
-        for slot, req in list(self._decoding.items()):
-            if bool(bad_np[slot]):
-                self._on_poisoned_slot(slot, req)
-                continue
-            req.out.append(int(tok_np[slot]))
-            if bool(done_np[slot]):
-                del self._decoding[slot]
-                self._retire(req)
 
     def _retire(self, req: _Request) -> None:
         """Natural completion: the device row already flagged itself done, so
@@ -805,15 +898,23 @@ class ServingEngine:
         else:
             self._fstats[{"timeout": "timeouts", "shed": "sheds",
                           "failed": "failed"}[status]] += 1
+        if req.canary and req.weights_version in self._cohorts:
+            self._cohorts[req.weights_version]["events"].append({
+                "status": status, "ttft_s": ttft, "tpot_s": tpot,
+            })
         self._finished.append({
             "id": req.id, "status": status, "tokens": row, "new_tokens": n_new,
             "ttft_s": ttft, "tpot_s": tpot,
+            "weights_version": req.weights_version,
         })
+        if len(self._params_by_version) > 1:
+            self._gc_versions()
         if self.telemetry is not None:
             self.telemetry.record_event(
                 "serving_request_done", request_id=req.id, status=status,
                 ttft_s=ttft, tpot_s=tpot, new_tokens=n_new,
                 prompt_tokens=int(req.tokens.size), slot=req.slot,
+                weights_version=req.weights_version,
             )
             if status != "ok":
                 self.telemetry.record_event(
@@ -897,6 +998,10 @@ class ServingEngine:
         del self._decoding[slot]
         self._quarantine_slot(slot)
         req.slot = None
+        if req.canary and req.weights_version in self._cohorts:
+            # The canary SLO comparison counts sentinel trips per cohort — a
+            # candidate that NaNs under load must read as a regression.
+            self._cohorts[req.weights_version]["poisoned"] += 1
         self._retry_or_fail(req, reason=f"nonfinite logits in slot {slot}")
 
     def _quarantine_slot(self, slot: int) -> None:
@@ -933,6 +1038,239 @@ class ServingEngine:
                 )
             self._poison_op = jax.jit(poison, donate_argnums=(0,))
         self._cache = self._poison_op(self._cache, np.int32(slot))
+
+    # -- weight publication (the publish.py hot-swap seam) -----------------
+
+    @property
+    def weights_version(self) -> int:
+        """Monotonic version tag of the PRIMARY param tree — the one new
+        admissions bind outside a canary window (0 = the construction-time
+        weights)."""
+        return self._weights_version
+
+    def _params_for(self, version):
+        """The param tree a request bound at grant. Versions stay installed
+        until every in-flight reference drains, so this never misses."""
+        if version == self._weights_version:
+            return self._params
+        return self._params_by_version[version]
+
+    def _route_version(self) -> int:
+        """Version for a fresh grant. Outside a canary window: the primary.
+        Inside one: an error-diffusion accumulator routes EXACTLY the
+        configured fraction of admissions to the candidate (deterministic —
+        no RNG — so a chaos replay routes identically)."""
+        c = self._canary
+        if c is None:
+            return self._weights_version
+        self._canary_acc += c["fraction"]
+        if self._canary_acc >= 1.0 - 1e-9:
+            self._canary_acc -= 1.0
+            c["routed_candidate"] += 1
+            return c["version"]
+        c["routed_primary"] += 1
+        return self._weights_version
+
+    def _install_params(self, params, version: int) -> None:
+        """Placement hook: bind ``params`` (already validated) as ``version``.
+        The disagg router overrides this to place the decode-mesh copy and
+        the per-lane prefill copies."""
+        self._params_by_version[int(version)] = _commit_params(params)
+
+    def _drop_params(self, version: int) -> None:
+        """Placement hook: release a retired version's buffers."""
+        self._params_by_version.pop(int(version), None)
+
+    def _gc_versions(self) -> None:
+        """Drop param versions that are neither primary, candidate, nor
+        referenced by any in-flight request — the moment the last old-version
+        request drains, the old buffers go."""
+        keep = {self._weights_version}
+        if self._canary is not None:
+            keep.add(self._canary["version"])
+        for r in itertools.chain(self._queue, self._prefilling,
+                                 self._decoding.values()):
+            if r.weights_version is not None:
+                keep.add(r.weights_version)
+        for v in [v for v in self._params_by_version if v not in keep]:
+            self._drop_params(v)
+
+    def _validate_params_tree(self, params) -> None:
+        """The guarded swap seam: the incoming tree must match the serving
+        tree leaf-for-leaf in structure, shape, dtype, AND sharding, and
+        every leaf must already be a committed device array — anything else
+        would silently recompile the decode step (new avals/shardings) or
+        crash mid-tick, so it is rejected here with the offending leaf
+        named."""
+        from .parallel.sharding import _path_to_name
+
+        cur = self._params
+        ref = jax.tree_util.tree_structure(cur)
+        got = jax.tree_util.tree_structure(params)
+        if ref != got:
+            raise ValueError(
+                "swap_params: param tree structure does not match the "
+                f"serving tree (serving {ref.num_leaves} leaves, got "
+                f"{got.num_leaves}); publish the same model family/config "
+                "the engine was built with."
+            )
+        new_leaves = jax.tree_util.tree_leaves(params)
+        for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(cur)[0], new_leaves):
+            name = _path_to_name(path)
+            if not isinstance(b, jax.Array):
+                raise ValueError(
+                    f"swap_params: leaf {name!r} is {type(b).__name__}, not "
+                    "a committed jax.Array — redistribute onto the serving "
+                    "placement first (publish.py does this via the reshard "
+                    "executor)."
+                )
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise ValueError(
+                    f"swap_params: leaf {name!r} is {b.shape}/{b.dtype}, "
+                    f"serving expects {a.shape}/{a.dtype}."
+                )
+            sa = getattr(a, "sharding", None)
+            sb = getattr(b, "sharding", None)
+            if sa is not None and sb is not None and \
+                    not sb.is_equivalent_to(sa, a.ndim):
+                raise ValueError(
+                    f"swap_params: leaf {name!r} sharding {sb} is not "
+                    f"equivalent to the serving sharding {sa} — a mismatch "
+                    "here would recompile the ONE decode executable."
+                )
+
+    def _check_new_version(self, weights_version) -> int:
+        v = int(weights_version)
+        if v <= self._weights_version:
+            raise ValueError(
+                f"weights_version {v} is not newer than the serving primary "
+                f"{self._weights_version}; versions are monotonic (train "
+                "step)."
+            )
+        if self._canary is not None:
+            raise ValueError(
+                f"a canary for version {self._canary['version']} is active; "
+                "promote or roll it back before publishing again."
+            )
+        return v
+
+    def swap_params(self, params, *, weights_version: int) -> None:
+        """Full cutover: validate ``params`` against the serving tree and
+        bind them as the new PRIMARY version. In-flight requests finish on
+        the version they bound at grant (the old buffers stay installed
+        until they drain); every admission from now on binds the new one.
+        Zero downtime, zero decode recompiles (params are a non-donated
+        argument of the ONE decode executable)."""
+        v = self._check_new_version(weights_version)
+        self._validate_params_tree(params)
+        self._install_params(params, v)
+        self._weights_version = v
+        self._params = self._params_by_version[v]
+        self._gc_versions()
+        if _log_ok():
+            logger.info("serving: params swapped to version %d", v)
+
+    def begin_canary(self, params, *, weights_version: int,
+                     fraction: float = 0.1) -> None:
+        """Install ``params`` as a CANDIDATE version and start routing
+        ``fraction`` of new admissions to it (error-diffusion — the realized
+        fraction is exact, not stochastic). Primary traffic continues
+        untouched; per-cohort SLO samples accumulate until
+        :meth:`promote_canary` or :meth:`rollback_canary` ends the window
+        (publish.py's ``WeightPublisher`` drives that decision)."""
+        if not 0.0 < float(fraction) <= 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1], got {fraction}")
+        v = self._check_new_version(weights_version)
+        self._validate_params_tree(params)
+        self._install_params(params, v)
+        self._canary = {
+            "version": v, "fraction": float(fraction),
+            "routed_candidate": 0, "routed_primary": 0,
+            "started_tick": self._stats["ticks"],
+        }
+        self._canary_acc = 0.0
+        self._cohorts = {
+            self._weights_version: {"events": [], "poisoned": 0},
+            v: {"events": [], "poisoned": 0},
+        }
+
+    def promote_canary(self) -> dict:
+        """End the canary window by making the candidate PRIMARY. In-flight
+        old-version requests drain on the old buffers (then they are GC'd);
+        all new admissions bind the promoted version."""
+        c = self._require_canary()
+        self._canary = None
+        self._weights_version = c["version"]
+        self._params = self._params_by_version[c["version"]]
+        self._fstats["promoted"] += 1
+        self._gc_versions()
+        if _log_ok():
+            logger.info(
+                "serving: canary promoted — version %d is primary "
+                "(%d canary / %d primary admissions in the window)",
+                c["version"], c["routed_candidate"], c["routed_primary"],
+            )
+        return c
+
+    def rollback_canary(self) -> dict:
+        """End the canary window by discarding the candidate: new admissions
+        bind the (never unbound) primary again — bit-equal to never having
+        published. Candidate-bound in-flight requests finish on the
+        candidate buffers, which are GC'd once they drain."""
+        c = self._require_canary()
+        self._canary = None
+        self._fstats["rolled_back"] += 1
+        self._gc_versions()
+        if _log_ok():
+            logger.warning(
+                "serving: canary version %d rolled back — primary stays %d "
+                "(%d canary / %d primary admissions in the window)",
+                c["version"], self._weights_version,
+                c["routed_candidate"], c["routed_primary"],
+            )
+        return c
+
+    def _require_canary(self) -> dict:
+        if self._canary is None:
+            raise ValueError("no canary window is active")
+        return self._canary
+
+    def canary_status(self) -> Optional[dict]:
+        """The active canary window (version, fraction, per-arm routing
+        counts), or None."""
+        return dict(self._canary) if self._canary is not None else None
+
+    def cohort_stats(self, version: int, warmup: int = 0) -> Optional[dict]:
+        """SLO aggregates for one canary cohort, skipping that cohort's
+        first ``warmup`` terminal events (warm caches / first-dispatch noise
+        must not decide a rollback). ``None`` until the version has a
+        cohort. Rates are over the post-warmup window; TTFT/TPOT means are
+        ok-only, matching the engine-wide aggregates."""
+        co = self._cohorts.get(version)
+        if co is None:
+            return None
+        events = co["events"][int(warmup):]
+        n = len(events)
+        ok = [e for e in events if e["status"] == "ok"]
+        ttft = [e["ttft_s"] for e in ok if e["ttft_s"] is not None]
+        tpot = [e["tpot_s"] for e in ok if e["tpot_s"] is not None]
+
+        def rate(status):
+            return (sum(1 for e in events if e["status"] == status) / n
+                    if n else 0.0)
+
+        return {
+            "version": int(version),
+            "completed": n,
+            "ok": len(ok),
+            "ok_ttft_mean_s": float(np.mean(ttft)) if ttft else None,
+            "ok_tpot_mean_s": float(np.mean(tpot)) if tpot else None,
+            "timeout_rate": rate("timeout"),
+            "shed_rate": rate("shed"),
+            "failed_rate": rate("failed"),
+            "poisoned": int(co["poisoned"]),
+        }
 
     # -- batch front-end ---------------------------------------------------
 
@@ -1062,6 +1400,8 @@ class ServingEngine:
             "steady_recompiles": s["steady_recompiles"],
             "decode_executables": execs["decode"],
             "prefill_executables": execs["prefill"],
+            "weights_version": self._weights_version,
+            "canary": self.canary_status(),
             "faults": self.fault_stats(),
         }
         return out

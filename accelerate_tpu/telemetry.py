@@ -181,6 +181,9 @@ class TelemetryRecorder:
         # Disaggregated-serving block (disagg.py): slice plan, handoff
         # bytes/latency, measured prefill:decode FLOP ratio.
         self._disagg_summary: Optional[dict] = None
+        # Weight-publication block (publish.py): publish/promote/rollback
+        # counts, redistribution bytes, swap latency.
+        self._publish_summary: Optional[dict] = None
         # Auto-parallelism plan (planner.py): note_plan installs the active
         # plan; after _plan_calibrate_after steps the measured step time +
         # peak HBM are written back into the plan artifact (the calibration
@@ -466,6 +469,18 @@ class TelemetryRecorder:
             ck["async_errors"] += 1
         elif event == "serving_request_done":
             self._serving_requests += 1
+        elif event == "weights_published":
+            # Publication lifecycle tally (publish.py): one event per
+            # outcome — canary/cutover on publish, then promoted /
+            # rolled_back / aborted as the canary window resolves.
+            pub = self._publish_summary
+            if pub is None:
+                pub = self._publish_summary = {"by_outcome": {}}
+            by = pub["by_outcome"]
+            outcome = str(fields.get("outcome"))
+            by[outcome] = by.get(outcome, 0) + 1
+            if "version" in fields:
+                pub["last_version"] = fields.get("version")
         elif event == "fault_injected":
             self._faults["injected"] += 1
             site = f"{fields.get('point')}:{fields.get('kind')}"
@@ -618,6 +633,24 @@ class TelemetryRecorder:
             **self._disagg_summary,
         })
 
+    def record_publish(self, block: dict) -> None:
+        """Weight-publication aggregate (publish.py ``stats()``): scans,
+        publishes, promotions/rollbacks, BandwidthTable-priced
+        redistribution bytes and swap latency. Written as a JSONL record
+        and embedded as the summary's ``publish`` block; the outcome tally
+        accumulated from ``weights_published`` events is preserved under
+        ``by_outcome``. Last push wins."""
+        prev = self._publish_summary or {}
+        merged = dict(block)
+        if "by_outcome" in prev:
+            merged["by_outcome"] = dict(prev["by_outcome"])
+        if "last_version" in prev and "last_version" not in merged:
+            merged["last_version"] = prev["last_version"]
+        self._publish_summary = merged
+        self.record_event("publish_summary", **{
+            k: v for k, v in block.items() if not isinstance(v, (dict, list))
+        })
+
     # -- output ------------------------------------------------------------
 
     def _write(self, record: dict):
@@ -696,6 +729,10 @@ class TelemetryRecorder:
             # Disaggregated-serving block (disagg.py): slice plan + KV-page
             # handoff bytes/latency; bench rows embed it alongside "serving".
             out["disagg"] = dict(self._disagg_summary)
+        if self._publish_summary is not None:
+            # Weight-publication block (publish.py): publish outcomes,
+            # redistribution bytes, swap latency; rides next to "serving".
+            out["publish"] = dict(self._publish_summary)
         plan_block = self.plan_block()
         if plan_block is not None:
             # Auto-parallelism plan block (planner.py): predicted vs

@@ -1,0 +1,399 @@
+"""`make publish-smoke`: zero-downtime weight publication end to end.
+
+Acceptance shape of the train-to-serve publication pillar (publish.py +
+serving.py + fault_tolerance.py + chaos.py) on the 8-device virtual CPU
+mesh:
+
+1. A training run and a live serving engine share one process: the engine
+   drains a deterministic Poisson arrival trace while training steps run
+   between ticks, committing verified checkpoints at steps 3 and 5.
+2. A :class:`~accelerate_tpu.publish.WeightPublisher` watches the
+   checkpoint dir and publishes twice. Publish #1 (version 3) opens a
+   canary window with loose SLO thresholds and PROMOTES. Publish #2
+   (version 5) hits a seeded ``canary_window``/``slo_regression`` fault
+   and ROLLS BACK — then stays quarantined: post-rollback scans refuse
+   the still-newest-on-disk bad checkpoint.
+3. Zero downtime: every request in the trace (and every canary-window
+   filler) finishes ``ok`` — nothing is dropped, shed, or failed across
+   both swaps — and the decode executable census stays at ONE program
+   with zero steady-state recompiles.
+4. Version tags flip only post-swap: every ``poll()`` row carries the
+   ``weights_version`` it bound at grant; rows retired before publish #1
+   are all version 0 and bit-equal to a publish-free reference run of the
+   same trace; tagged rows never precede their version's publish tick.
+5. Rollback is bit-equal: a probe request after the rollback decodes on
+   version 3 and its tokens equal a direct ``generate()`` over the
+   checkpoint-3 weights loaded from disk.
+6. The whole run replays bit-identically: a second worker under the same
+   seed/schedule produces the same statuses, token streams, version
+   tags, publish decisions, and injected-fault log.
+
+The worker subprocess is this same file with ``--worker``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+N_REQS = 24
+MAX_NEW = 4
+N_SLOTS = 4
+TRAIN_AT = {3: False, 5: False, 7: True, 9: False, 11: True}  # tick -> save?
+CHAOS_SEED = 11
+# Versions are the manifest's train step: 3 then 5. Only publish #2's
+# canary decision (unit=5) is scheduled to read as an SLO regression.
+CHAOS_SCHEDULE = [
+    {"point": "canary_window", "kind": "slo_regression", "unit": 5},
+]
+MAX_TICKS = 600
+
+
+def _trace(rng):
+    """(arrival_tick, prompt) pairs — Poisson inter-arrivals, prompt
+    lengths within one prefill chunk so the ladder compiles once."""
+    ticks = np.cumsum(1 + rng.poisson(1.0, N_REQS))
+    out = []
+    for t in ticks:
+        n = int(rng.integers(3, 9))
+        out.append((int(t), rng.integers(1, 256, (n,), dtype=np.int32)))
+    return out
+
+
+def worker(project_dir: str, status_file: str, publish: bool) -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import (
+        Accelerator,
+        FaultInjector,
+        Model,
+        PublishConfig,
+        ServingConfig,
+        ServingEngine,
+        WeightPublisher,
+        generate,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import (
+        FaultToleranceKwargs,
+        ProjectConfiguration,
+        set_seed,
+    )
+    from accelerate_tpu.utils.other import (
+        load_sharded_safetensors,
+        unflatten_state_dict,
+    )
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+
+    # -- the training side: commits verified checkpoints at steps 3 and 5 --
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True),
+        kwargs_handlers=[FaultToleranceKwargs()],
+    )
+    train_model = Model.from_flax(module, jax.random.key(1), probe)
+    tokens = rng.integers(0, cfg.vocab_size, (64, 16), dtype=np.int32)
+
+    class DS:
+        def __len__(self):
+            return len(tokens)
+
+        def __getitem__(self, i):
+            return {"input_ids": tokens[i]}
+
+    class Spec:
+        dataset = DS()
+        batch_size = 8
+        sampler = None
+        drop_last = False
+
+    train_model, _, dl = acc.prepare(train_model, optax.adam(1e-3), Spec())
+
+    def loss_fn(params, batch):
+        ids = batch["input_ids"]
+        logits = module.apply({"params": params}, ids[:, :-1])
+        if isinstance(logits, (tuple, list)):
+            logits = logits[0]
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, ids[:, 1:][..., None], -1))
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    batches = iter(dl)
+
+    # -- the serving side: a differently-initialized model, so a swap
+    # visibly changes the decoded stream --------------------------------
+    serve_model = Model.from_flax(module, jax.random.key(0), probe)
+    engine = ServingEngine(serve_model, ServingConfig(
+        n_slots=N_SLOTS, max_len=64, prefill_chunks=[8]))
+    pub = None
+    if publish:
+        pub = WeightPublisher(
+            engine,
+            PublishConfig(
+                checkpoint_dir=os.path.join(project_dir, "checkpoints"),
+                canary_fraction=0.5, canary_warmup=1, min_cohort=3,
+                # Loose latency/rate gates: wall-clock noise must never
+                # decide; only the seeded slo_regression can roll back.
+                max_ttft_ratio=100.0, max_tpot_ratio=100.0,
+                max_rate_increase=1.0,
+            ),
+            chaos=FaultInjector(seed=CHAOS_SEED, schedule=CHAOS_SCHEDULE),
+            telemetry=acc.telemetry,
+        )
+
+    arrivals = _trace(np.random.default_rng(7))
+    # Filler prompts keep the canary windows fed after the main trace ends.
+    filler_rng = np.random.default_rng(13)
+    next_filler_tick = arrivals[-1][0] + 2
+
+    rows = {}
+    publishes = []   # (tick, sanitized record)
+    decisions = 0
+    submitted = 0
+    tick = 0
+    for tick in range(MAX_TICKS):
+        while arrivals and arrivals[0][0] <= tick:
+            _, prompt = arrivals.pop(0)
+            engine.submit(prompt, max_new_tokens=MAX_NEW)
+            submitted += 1
+        if publish and decisions < 2 and tick >= next_filler_tick:
+            engine.submit(filler_rng.integers(1, 256, (6,), dtype=np.int32),
+                          max_new_tokens=MAX_NEW)
+            submitted += 1
+            next_filler_tick = tick + 2
+        if tick in TRAIN_AT:
+            state, _ = step(state, next(batches))
+            if TRAIN_AT[tick]:
+                acc.save_state()
+        engine.tick()
+        for row in engine.poll():
+            rows[row["id"]] = {
+                "id": row["id"], "status": row["status"], "tick": tick,
+                "version": row["weights_version"],
+                "tokens": np.asarray(row["tokens"]).tolist(),
+            }
+        if pub is not None:
+            rec = pub.poll()
+            if rec is not None:
+                publishes.append((tick, {
+                    k: rec.get(k)
+                    for k in ("action", "mode", "version", "bytes", "reasons")
+                    if k in rec
+                }))
+                if rec["action"] in ("promoted", "rolled_back"):
+                    decisions += 1
+        if len(rows) >= submitted and not arrivals and (
+                pub is None or decisions >= 2):
+            break
+
+    # Post-rollback quarantine: more polls must refuse the still-newest
+    # bad checkpoint and leave the promoted version serving.
+    if pub is not None:
+        for _ in range(3):
+            assert pub.poll() is None
+        assert int(engine.weights_version) == 3, engine.weights_version
+        assert pub.stats()["skipped_vetoed"] >= 1, pub.stats()
+
+    # Probe: decodes on the post-rollback primary; bit-equal to a direct
+    # generate() over the checkpoint-3 weights loaded from disk.
+    probe_prompt = np.arange(1, 7, dtype=np.int32)
+    pid = engine.submit(probe_prompt, max_new_tokens=MAX_NEW)
+    probe_row = None
+    for _ in range(100):
+        engine.tick()
+        for row in engine.poll():
+            if row["id"] == pid:
+                probe_row = row
+        if probe_row is not None:
+            break
+    assert probe_row is not None, "probe request never finished"
+    probe_tokens = np.asarray(probe_row["tokens"]).tolist()
+    probe_direct_equal = None
+    if publish:
+        ckpt3 = os.path.join(project_dir, "checkpoints", "checkpoint_0")
+        loaded = unflatten_state_dict(load_sharded_safetensors(ckpt3))
+        ref = generate(Model(module=module, params=loaded),
+                       probe_prompt[None], max_new_tokens=MAX_NEW)
+        ref = np.asarray(jax.device_get(ref))[0]
+        probe_direct_equal = bool(np.array_equal(
+            np.asarray(probe_row["tokens"])[: ref.shape[0]], ref))
+
+    es = engine.stats()
+    status = {
+        "rows": [rows[k] for k in sorted(rows)],
+        "submitted": submitted,
+        "probe": {"tokens": probe_tokens,
+                  "version": probe_row["weights_version"],
+                  "direct_equal": probe_direct_equal},
+        "publishes": publishes,
+        "fault_log": list(pub.chaos.injected) if pub is not None else [],
+        "publisher": {
+            k: v for k, v in (pub.stats() if pub is not None else {}).items()
+            if k in ("scans", "published", "promoted", "rolled_back",
+                     "aborted", "skipped_unverified", "skipped_stale",
+                     "skipped_vetoed", "bytes_planned", "bytes_moved")
+        },
+        "engine": {
+            "weights_version": es["weights_version"],
+            "steady_recompiles": es["steady_recompiles"],
+            "decode_executables": es["decode_executables"],
+            "promoted": es["faults"]["promoted"],
+            "rolled_back": es["faults"]["rolled_back"],
+            "sheds": es["faults"]["sheds"],
+            "timeouts": es["faults"]["timeouts"],
+            "failed": es["faults"]["failed"],
+        },
+    }
+    acc.end_training()
+    with open(status_file, "w") as f:
+        json.dump(status, f)
+    print(f"PUBLISH_SMOKE_WORKER_DONE rows={len(rows)} "
+          f"publishes={len(publishes)}", flush=True)
+    return 0
+
+
+def _launch_worker(project_dir: str, status_file: str, publish: bool):
+    env = {**os.environ}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"), repo_root, os.getcwd()) if p
+    )
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           f"--project-dir={project_dir}", f"--status-file={status_file}"]
+    if publish:
+        cmd.append("--publish")
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1, env=env,
+    )
+
+
+def _drain(proc, timeout_s: float = 420.0) -> str:
+    out = []
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line:
+            out.append(line)
+            sys.stderr.write(line)
+    if proc.poll() is None:
+        proc.kill()
+        raise AssertionError("worker hung past the smoke timeout")
+    out.append(proc.stdout.read() or "")
+    sys.stderr.write(out[-1])
+    return "".join(out)
+
+
+def _run(tmp: str, name: str, publish: bool) -> dict:
+    project_dir = os.path.join(tmp, name)
+    status_file = os.path.join(tmp, f"{name}_status.json")
+    proc = _launch_worker(project_dir, status_file, publish)
+    _drain(proc)
+    assert proc.returncode == 0, f"{name} worker failed rc={proc.returncode}"
+    with open(status_file) as f:
+        return json.load(f)
+
+
+def main() -> int:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="publish_smoke_")
+
+    ref = _run(tmp, "reference", publish=False)
+    p1 = _run(tmp, "publish1", publish=True)
+    p2 = _run(tmp, "publish2", publish=True)
+
+    # -- zero downtime: every request ok, across both swaps ----------------
+    for name, s in (("reference", ref), ("publish1", p1), ("publish2", p2)):
+        assert all(r["status"] == "ok" for r in s["rows"]), (name, s["rows"])
+        assert len(s["rows"]) == s["submitted"], name
+        e = s["engine"]
+        assert e["sheds"] == e["timeouts"] == e["failed"] == 0, (name, e)
+        assert e["steady_recompiles"] == 0, (name, e)
+        assert e["decode_executables"] == 1, (name, e)
+    assert all(r["version"] == 0 for r in ref["rows"]), ref["rows"]
+
+    # -- the publish story: canary promote, then seeded rollback -----------
+    actions = [(r["action"], r.get("version")) for _, r in p1["publishes"]]
+    assert actions == [
+        ("published", 3), ("promoted", 3),
+        ("published", 5), ("rolled_back", 5),
+    ], actions
+    assert p1["publishes"][2][1]["mode"] == "canary"
+    assert p1["publishes"][3][1]["reasons"] == ["injected slo_regression"]
+    assert p1["engine"]["weights_version"] == 3
+    assert p1["engine"]["promoted"] == 1 and p1["engine"]["rolled_back"] == 1
+    pubs = p1["publisher"]
+    assert pubs["published"] == 2 and pubs["aborted"] == 0, pubs
+    assert pubs["skipped_vetoed"] >= 1, pubs
+    assert pubs["bytes_moved"] > 0, pubs
+    assert p1["fault_log"] == [
+        {"tick": 1, "point": "canary_window", "kind": "slo_regression",
+         "unit": 5},
+    ], p1["fault_log"]
+
+    # -- version tags flip only post-swap ----------------------------------
+    publish_tick = {r["version"]: t for t, r in p1["publishes"]
+                    if r["action"] == "published"}
+    versions = {r["version"] for r in p1["rows"]}
+    assert versions == {0, 3, 5}, versions
+    for r in p1["rows"]:
+        if r["version"] != 0:
+            assert r["tick"] >= publish_tick[r["version"]], r
+        if r["tick"] < publish_tick[3]:
+            assert r["version"] == 0, r
+
+    # -- v0 rows bit-equal to the publish-free reference -------------------
+    ref_rows = {r["id"]: r for r in ref["rows"]}
+    v0 = [r for r in p1["rows"] if r["version"] == 0 and r["id"] in ref_rows]
+    assert v0, "no version-0 rows to compare"
+    for r in v0:
+        assert r["tokens"] == ref_rows[r["id"]]["tokens"], r["id"]
+
+    # -- rollback bit-equal: probe serves checkpoint-3 weights exactly -----
+    assert p1["probe"]["version"] == 3, p1["probe"]
+    assert p1["probe"]["direct_equal"] is True, p1["probe"]
+
+    # -- the whole run replays bit-identically -----------------------------
+    for key in ("rows", "publishes", "fault_log", "publisher", "engine",
+                "probe", "submitted"):
+        assert p1[key] == p2[key], (
+            f"publish replay diverged on {key!r}:\n  {p1[key]}\n  {p2[key]}")
+
+    print(
+        "PUBLISH SMOKE OK — "
+        f"{p1['submitted']} requests all ok across 2 swaps; "
+        "canary v3 promoted, v5 rolled back on the seeded SLO regression "
+        "and stayed quarantined; "
+        f"{len(v0)} v0 rows bit-equal to the publish-free reference; "
+        "post-rollback probe bit-equal to direct checkpoint-3 load; "
+        "1 decode executable, 0 steady-state recompiles; "
+        "replay bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--publish", action="store_true")
+    parser.add_argument("--project-dir", default=None)
+    parser.add_argument("--status-file", default=None)
+    args = parser.parse_args()
+    if args.worker:
+        sys.exit(worker(args.project_dir, args.status_file, args.publish))
+    sys.exit(main())

@@ -30,10 +30,16 @@ Three rows, one JSON line each:
   status counts, retries, quarantines, injected-fault log size — embedded
   in the row, so robustness overhead shows up in the perf trajectory next
   to the fault-free rows.
+- ``--publish`` (implies ``--serving``) adds a ``serving_publish`` row: a
+  committed, manifest-verified checkpoint hot-swapped into the live engine
+  mid-trace by the :class:`~accelerate_tpu.publish.WeightPublisher` —
+  swap latency, BandwidthTable-priced redistribution bytes, the canary
+  window (routed counts + decision), and the faults block, with the
+  zero-recompile swap evidenced by the executable census.
 
     python benchmarks/generate_bench.py [--params-b 1] [--new-tokens 64]
                                         [--serving] [--disagg] [--chaos]
-                                        [--qps 8]
+                                        [--publish] [--qps 8]
 """
 
 import argparse
@@ -91,12 +97,17 @@ def main():
                     help="add a serving_chaos row (same trace under a "
                          "deterministic FaultInjector; implies --serving)")
     ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--publish", action="store_true",
+                    help="add a serving_publish row (hot-swap a committed "
+                         "checkpoint into the live engine mid-trace through "
+                         "a canary window; implies --serving)")
+    ap.add_argument("--canary-fraction", type=float, default=0.25)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--qps", type=float, default=8.0,
                     help="Poisson arrival rate for the serving rows")
     args = ap.parse_args()
-    if args.disagg or args.chaos:
+    if args.disagg or args.chaos or args.publish:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -354,6 +365,85 @@ def main():
                 row["degraded"] = cst["disagg"]["degraded"]
                 row["healthy_lanes"] = cst["disagg"]["healthy_lanes"]
             print(json.dumps(row), flush=True)
+
+        # Publish row: hot-swap a committed, manifest-verified checkpoint
+        # into the live engine mid-trace. The publisher redistributes the
+        # weights through the reshard executor (bytes priced against the
+        # BandwidthTable), opens a canary window over `--canary-fraction`
+        # of new admissions, and promotes on the loose SLO gates — the row
+        # records swap latency, redistribution bytes, the canary window,
+        # and the faults block next to the fault-free serving rows.
+        if args.publish:
+            from accelerate_tpu import PublishConfig, WeightPublisher
+            from accelerate_tpu.fault_tolerance import write_manifest
+
+            pub_root = tempfile.mkdtemp(prefix="gen_bench_publish_")
+            pub_ckpt = os.path.join(pub_root, "checkpoint_0")
+            os.makedirs(pub_ckpt)
+            save_sharded_safetensors(
+                {k: np.asarray(v)
+                 for k, v in flatten_state_dict(host_params).items()},
+                pub_ckpt, max_shard_size=2 * 1024**3,
+            )
+            write_manifest(pub_ckpt, step=1, world_size=1)
+
+            pengine = ServingEngine(res_model, scfg)
+            pengine.warmup()
+            pub = WeightPublisher(pengine, PublishConfig(
+                checkpoint_dir=pub_root,
+                canary_fraction=args.canary_fraction,
+                canary_warmup=1, min_cohort=3,
+                max_ttft_ratio=100.0, max_tpot_ratio=100.0,
+                max_rate_increase=1.0,
+            ))
+            order = sorted(range(n), key=lambda i: float(arrivals[i]))
+            filler = srng.integers(1, cfg.vocab_size, (8,), dtype=np.int32)
+            fillers_left = 64
+            t0 = time.perf_counter()
+            nxt = 0
+            decision = None
+            while nxt < n or pengine.pending or (
+                    decision is None and fillers_left > 0):
+                now = time.perf_counter() - t0
+                while nxt < n and float(arrivals[order[nxt]]) <= now:
+                    i = order[nxt]
+                    pengine.submit(reqs[i], max_new_tokens=int(budgets[i]))
+                    nxt += 1
+                if nxt >= n and decision is None and not pengine.pending \
+                        and fillers_left > 0:
+                    # The trace drained before the canary window filled:
+                    # keep the cohorts fed so the decision lands.
+                    pengine.submit(filler, max_new_tokens=8)
+                    fillers_left -= 1
+                if pengine.pending:
+                    pengine.tick()
+                    pengine.poll()
+                rec = pub.poll()
+                if rec is not None and rec["action"] in ("promoted",
+                                                         "rolled_back"):
+                    decision = rec
+            pub_s = time.perf_counter() - t0
+            pst = pengine.stats()
+            ps = pub.stats()
+            published = next((r for r in pub.history
+                              if r["action"] == "published"), {})
+            print(json.dumps({
+                "row": "serving_publish", "seconds": round(pub_s, 3),
+                "weights_version": pst["weights_version"],
+                "swap_s": published.get("swap_s"),
+                "planned_bytes": ps["bytes_planned"],
+                "redistributed_bytes": ps["bytes_moved"],
+                "predicted_transfer_s": ps["predicted_transfer_s"],
+                "transfer_wall_s": ps["transfer_wall_s"],
+                "n_devices": published.get("n_devices"),
+                "canary_fraction": args.canary_fraction,
+                "decision": (decision or {}).get("action"),
+                "canary_window": (decision or {}).get("routed"),
+                "tokens_per_s": pst["tokens_per_s"],
+                "decode_executables": pst["decode_executables"],
+                "steady_recompiles": pst["steady_recompiles"],
+                "faults": pst["faults"],
+            }), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)

@@ -130,6 +130,8 @@ def test_injector_validation():
         # training
         "train_step", "collective_op", "checkpoint_save", "dataloader_batch",
         "host_heartbeat",
+        # weight publication
+        "publish_manifest", "publish_transfer", "canary_window",
     }
 
 
@@ -454,7 +456,7 @@ def test_off_by_default_no_chaos_no_faults(llama):
     for i in ids:
         assert res[i]["status"] == "ok"
         assert set(res[i]) == {"id", "status", "tokens", "new_tokens",
-                               "ttft_s", "tpot_s"}
+                               "ttft_s", "tpot_s", "weights_version"}
     f = eng.stats()["faults"]
     assert f["injected"] == 0 and f["degraded"] is False
     assert all(v in (0, False) for v in f.values())

@@ -1,0 +1,616 @@
+"""Zero-downtime weight publication (publish.py + the serving swap seam):
+guarded swap validation, double-buffered mid-flight swaps with version-tagged
+rows, the 0-recompile executable census across a swap, exact error-diffusion
+canary routing, promote/rollback + version GC, the checkpoint trust boundary
+(committed + manifest-verified + monotonic), rollback quarantine, the three
+publication chaos points, and cross-topology publish bit-equality through the
+reshard planner. All CPU-only, tier-1 fast."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import (
+    Model,
+    PublishConfig,
+    ServingConfig,
+    ServingEngine,
+    WeightPublisher,
+    generate,
+)
+from accelerate_tpu.chaos import FaultInjector
+from accelerate_tpu.fault_tolerance import write_manifest
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.constants import PLAN_MANIFEST_NAME
+from accelerate_tpu.utils.other import flatten_state_dict, save_sharded_safetensors
+
+
+@pytest.fixture(scope="module")
+def llama():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    probe = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+    return cfg, model
+
+
+def _prompts(cfg, lengths, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (n,), dtype=np.int32) for n in lengths]
+
+
+def _engine(model, n_slots=2, **kw):
+    return ServingEngine(
+        model, ServingConfig(n_slots=n_slots, max_len=64, prefill_chunks=[4, 8], **kw)
+    )
+
+
+def _variant(params, scale=1.25):
+    """A host (numpy) tree with the same structure/shapes/dtypes but
+    different values — a stand-in for a further-trained checkpoint."""
+    return jax.tree.map(
+        lambda a: (np.asarray(a) * scale).astype(np.asarray(a).dtype), params
+    )
+
+
+def _device_tree(host_tree):
+    return jax.tree.map(jax.device_put, host_tree)
+
+
+def _drain(engine, ids, publisher=None, max_ticks=400):
+    """Tick until every id has a terminal row; optionally poll the publisher
+    between ticks (the smoke's loop shape). Returns (rows_by_id, actions)."""
+    rows, actions = {}, []
+    for _ in range(max_ticks):
+        engine.tick()
+        for r in engine.poll():
+            rows[r["id"]] = r
+        if publisher is not None:
+            rec = publisher.poll()
+            if rec is not None:
+                actions.append(rec)
+        if all(i in rows for i in ids):
+            break
+    assert all(i in rows for i in ids), "requests did not drain"
+    return rows, actions
+
+
+def _write_ckpt(root, host_tree, step, *, manifest=True, plan=None, name=None):
+    """A committed checkpoint_N dir the way the trainer writes one: sharded
+    safetensors, optional plan-manifest sidecar, fault-tolerance manifest
+    LAST (it hashes and certifies everything already in the dir)."""
+    d = os.path.join(str(root), name or f"checkpoint_{step}")
+    os.makedirs(d, exist_ok=True)
+    flat = {k: np.asarray(v) for k, v in flatten_state_dict(host_tree).items()}
+    save_sharded_safetensors(flat, d)
+    if plan is not None:
+        with open(os.path.join(d, PLAN_MANIFEST_NAME), "w") as f:
+            json.dump(plan, f)
+    if manifest:
+        write_manifest(d, step=step, world_size=1)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# PublishConfig + guarded swap seam (satellite: descriptive validation)
+# ---------------------------------------------------------------------------
+
+
+def test_publish_config_validation():
+    with pytest.raises(ValueError, match="canary_fraction"):
+        PublishConfig(canary_fraction=0.0)
+    with pytest.raises(ValueError, match="canary_fraction"):
+        PublishConfig(canary_fraction=1.5)
+    with pytest.raises(ValueError, match="min_cohort"):
+        PublishConfig(min_cohort=0)
+    with pytest.raises(ValueError, match="ratios"):
+        PublishConfig(max_ttft_ratio=0.0)
+    with pytest.raises(ValueError, match="transfer_retries"):
+        PublishConfig(transfer_retries=-1)
+
+
+def test_swap_validation_names_the_offending_leaf(llama):
+    cfg, model = llama
+    engine = _engine(model)
+    good = _device_tree(model.params)
+
+    # Structure mismatch: a tree from a different model config.
+    with pytest.raises(ValueError, match="structure"):
+        engine.swap_params({"params": {}}, weights_version=1)
+
+    # Host leaf: redistribution skipped.
+    host = jax.tree.map(np.asarray, model.params)
+    with pytest.raises(ValueError, match="not\n?.*a committed jax.Array|jax.Array"):
+        engine.swap_params(host, weights_version=1)
+
+    # Shape mismatch on one leaf, named in the error.
+    def grow_first(tree):
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        flat = list(flat)
+        flat[0] = jnp.zeros((int(flat[0].shape[0]) + 1,) + flat[0].shape[1:],
+                            flat[0].dtype)
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    with pytest.raises(ValueError, match="serving expects"):
+        engine.swap_params(grow_first(good), weights_version=1)
+
+    # Dtype mismatch.
+    bad_dtype = jax.tree.map(lambda a: a.astype(jnp.float16), good)
+    with pytest.raises(ValueError, match="serving expects"):
+        engine.swap_params(bad_dtype, weights_version=1)
+
+    # Monotonic version guard: 0 is not newer than the construction tree.
+    with pytest.raises(ValueError, match="not newer"):
+        engine.swap_params(good, weights_version=0)
+
+    # No swap while a canary window is open.
+    engine.begin_canary(good, weights_version=1, fraction=0.5)
+    with pytest.raises(ValueError, match="canary"):
+        engine.swap_params(good, weights_version=2)
+    engine.rollback_canary()
+
+    # Nothing above mutated the serving state.
+    assert engine.weights_version == 0
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered hot swap (tentpole: in-flight on old, admissions on new)
+# ---------------------------------------------------------------------------
+
+
+def test_mid_flight_swap_version_tags_and_bit_equality(llama):
+    cfg, model = llama
+    variant_host = _variant(model.params)
+    variant_model = Model(module=model.module, params=_device_tree(variant_host))
+    engine = _engine(model, n_slots=2)
+    prompts = _prompts(cfg, [5, 5, 5, 5], seed=7)
+    budget = 6
+
+    old_ids = [engine.submit(p, max_new_tokens=budget) for p in prompts[:2]]
+    engine.tick()  # grant the old requests BEFORE the swap: they bind v0
+    engine.swap_params(_device_tree(variant_host), weights_version=3)
+    new_ids = [engine.submit(p, max_new_tokens=budget) for p in prompts[2:]]
+    rows, _ = _drain(engine, old_ids + new_ids)
+
+    for i, rid in enumerate(old_ids):
+        assert rows[rid]["status"] == "ok"
+        assert rows[rid]["weights_version"] == 0
+        want = np.asarray(generate(model, prompts[i][None], max_new_tokens=budget))[0]
+        np.testing.assert_array_equal(rows[rid]["tokens"], want)
+    for i, rid in enumerate(new_ids):
+        assert rows[rid]["status"] == "ok"
+        assert rows[rid]["weights_version"] == 3
+        want = np.asarray(
+            generate(variant_model, prompts[2 + i][None], max_new_tokens=budget)
+        )[0]
+        np.testing.assert_array_equal(rows[rid]["tokens"], want)
+
+    # The old version's buffers are GC'd once its last request drains.
+    assert engine.weights_version == 3
+    assert set(engine._params_by_version) == {3}
+
+
+def test_swap_keeps_one_decode_executable(llama):
+    """The executable census across a hot swap: decode stays ONE executable
+    with zero steady-state recompiles (satellite: 0-recompile census)."""
+    cfg, model = llama
+    engine = _engine(model, n_slots=2)
+    prompts = _prompts(cfg, [4, 6, 4, 6], seed=11)
+    ids = [engine.submit(p, max_new_tokens=4) for p in prompts[:2]]
+    _drain(engine, ids)
+    warm = engine.stats()
+    assert warm["decode_executables"] == 1
+
+    engine.swap_params(_device_tree(_variant(model.params)), weights_version=1)
+    ids = [engine.submit(p, max_new_tokens=4) for p in prompts[2:]]
+    _drain(engine, ids)
+    stats = engine.stats()
+    assert stats["decode_executables"] == 1
+    assert stats["steady_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Canary routing + decision plumbing on the engine
+# ---------------------------------------------------------------------------
+
+
+def test_canary_error_diffusion_routes_exact_fraction(llama):
+    cfg, model = llama
+    engine = _engine(model, n_slots=2)
+    engine.begin_canary(
+        _device_tree(_variant(model.params)), weights_version=1, fraction=0.5
+    )
+    ids = [engine.submit(p, max_new_tokens=3)
+           for p in _prompts(cfg, [4] * 8, seed=5)]
+    rows, _ = _drain(engine, ids)
+
+    status = engine.canary_status()
+    assert status["routed_candidate"] == 4 and status["routed_primary"] == 4
+    # Error diffusion is deterministic and alternating at 0.5 — admission
+    # order (submit order here) alternates primary, candidate, primary, ...
+    versions = [rows[i]["weights_version"] for i in ids]
+    assert versions == [0, 1, 0, 1, 0, 1, 0, 1]
+
+    prim = engine.cohort_stats(0)
+    cand = engine.cohort_stats(1)
+    assert prim["completed"] == 4 and cand["completed"] == 4
+    assert prim["ok"] == 4 and cand["ok"] == 4
+    # Warmup trims that cohort's first terminal events from the window.
+    assert engine.cohort_stats(1, warmup=3)["completed"] == 1
+    assert engine.cohort_stats(2) is None  # no cohort for that version
+
+    window = engine.promote_canary()
+    assert window["routed_candidate"] == 4
+    assert engine.weights_version == 1
+    assert engine.stats()["faults"]["promoted"] == 1
+
+
+def test_rollback_is_bit_equal_to_never_publishing(llama):
+    cfg, model = llama
+    prompts = _prompts(cfg, [5, 5], seed=9)
+    want = [np.asarray(generate(model, p[None], max_new_tokens=5))[0]
+            for p in prompts]
+
+    engine = _engine(model)
+    engine.begin_canary(
+        _device_tree(_variant(model.params)), weights_version=4, fraction=0.5
+    )
+    engine.rollback_canary()
+    assert engine.weights_version == 0
+    assert engine.stats()["faults"]["rolled_back"] == 1
+    assert set(engine._params_by_version) == {0}  # candidate buffers GC'd
+
+    ids = [engine.submit(p, max_new_tokens=5) for p in prompts]
+    rows, _ = _drain(engine, ids)
+    for rid, w in zip(ids, want):
+        assert rows[rid]["weights_version"] == 0
+        np.testing.assert_array_equal(rows[rid]["tokens"], w)
+
+
+# ---------------------------------------------------------------------------
+# The trust boundary: scan over a checkpoint root
+# ---------------------------------------------------------------------------
+
+
+def test_scan_refuses_torn_corrupt_and_legacy_dirs(llama, tmp_path):
+    cfg, model = llama
+    host = jax.tree.map(np.asarray, model.params)
+    good = _write_ckpt(tmp_path, host, 1)
+    # A torn staging dir never parses as a checkpoint name.
+    os.makedirs(tmp_path / "checkpoint_4.tmp")
+    # A legacy dir with no manifest is refused (newer index, but untrusted).
+    _write_ckpt(tmp_path, _variant(host), 3, manifest=False)
+    # A committed dir whose bytes rotted after the manifest hash fails verify.
+    corrupt = _write_ckpt(tmp_path, _variant(host), 2)
+    with open(os.path.join(corrupt, "model.safetensors"), "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\x00")
+
+    pub = WeightPublisher(
+        _engine(model), PublishConfig(checkpoint_dir=str(tmp_path))
+    )
+    found = pub.scan()
+    assert found == (good, 1)
+    assert pub.stats()["skipped_unverified"] == 2  # legacy + corrupt
+
+
+def test_scan_refuses_stale_and_duplicate_versions(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 2)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0),
+    )
+    rec = pub.poll()
+    assert rec["action"] == "published" and rec["mode"] == "cutover"
+    assert rec["version"] == 2 and engine.weights_version == 2
+    # The same newest-on-disk version is now a duplicate: refused, once.
+    assert pub.poll() is None
+    assert pub.stats()["skipped_stale"] == 1
+
+
+def test_manifest_version_precedence(tmp_path):
+    d = tmp_path / "checkpoint_7"
+    os.makedirs(d)
+    with open(d / "manifest.json", "w") as f:
+        json.dump({"weights_version": 42, "step": 9}, f)
+    assert WeightPublisher._manifest_version(str(d), 7) == 42
+    with open(d / "manifest.json", "w") as f:
+        json.dump({"step": 9}, f)
+    assert WeightPublisher._manifest_version(str(d), 7) == 9
+    with open(d / "manifest.json", "w") as f:
+        json.dump({}, f)
+    assert WeightPublisher._manifest_version(str(d), 7) == 7
+    os.remove(d / "manifest.json")
+    assert WeightPublisher._manifest_version(str(d), 7) == 7
+
+
+# ---------------------------------------------------------------------------
+# The full publish pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_cutover_publish_is_bit_equal_to_direct_load(llama, tmp_path):
+    cfg, model = llama
+    variant_host = _variant(model.params)
+    _write_ckpt(tmp_path, variant_host, 5)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0),
+    )
+    rec = pub.poll()
+    assert rec["version"] == 5 and rec["mode"] == "cutover"
+
+    prompt = _prompts(cfg, [6], seed=13)[0]
+    got = engine.run([prompt], max_new_tokens=5)[0]
+    variant_model = Model(module=model.module, params=_device_tree(variant_host))
+    want = np.asarray(generate(variant_model, prompt[None], max_new_tokens=5))[0]
+    np.testing.assert_array_equal(got, want)
+
+    stats = pub.stats()
+    assert stats["published"] == 1 and stats["weights_version"] == 5
+    assert stats["reshard"] is not None
+
+
+def test_canary_publish_promotes_on_healthy_slo(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 3)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(
+            checkpoint_dir=str(tmp_path), canary_fraction=0.5,
+            canary_warmup=0, min_cohort=3,
+            # Wide gates: wall-clock noise on a busy CI box must not flip
+            # the decision — only a seeded slo_regression can.
+            max_ttft_ratio=100.0, max_tpot_ratio=100.0, max_rate_increase=1.0,
+        ),
+    )
+    ids = [engine.submit(p, max_new_tokens=3)
+           for p in _prompts(cfg, [4] * 8, seed=17)]
+    rows, actions = _drain(engine, ids, publisher=pub)
+    assert [a["action"] for a in actions] == ["published", "promoted"]
+    assert actions[1]["reasons"] == []
+    assert actions[1]["cohorts"]["candidate"]["completed"] >= 3
+    assert engine.weights_version == 3
+    assert {rows[i]["weights_version"] for i in ids} == {0, 3}
+    assert pub.stats()["promoted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Publication chaos: the three injection points
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_torn_manifest_and_version_mismatch_refuse(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 2)
+    for kind, counter in (("torn_write", "skipped_unverified"),
+                          ("version_mismatch", "skipped_stale")):
+        engine = _engine(model)
+        pub = WeightPublisher(
+            engine,
+            PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0),
+            chaos=FaultInjector(
+                seed=3, schedule=[{"point": "publish_manifest", "kind": kind}]
+            ),
+        )
+        assert pub.poll() is None
+        assert pub.stats()[counter] == 1
+        assert engine.weights_version == 0  # old version keeps serving
+        # The schedule entry is spent: the next poll publishes cleanly.
+        assert pub.poll()["action"] == "published"
+        assert engine.weights_version == 2
+
+
+def _transfer_u(seed, version):
+    """The residual uniform publish's transfer draw sees for publish seq 0:
+    fresh injector per probe so the schedule entry is unspent."""
+    inj = FaultInjector(
+        seed=seed,
+        schedule=[{"point": "publish_transfer", "kind": "transfer_error"}],
+    )
+    return inj.draw("publish_transfer", 0, unit=version).u
+
+
+def test_chaos_transfer_transient_retries_then_succeeds(llama, tmp_path):
+    cfg, model = llama
+    variant_host = _variant(model.params)
+    _write_ckpt(tmp_path, variant_host, 2)
+    # u < 0.75 is the transient convention: exactly one failed attempt.
+    seed = next(s for s in range(64) if _transfer_u(s, 2) < 0.75)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0,
+                      backoff_s=0.0, backoff_cap_s=0.0),
+        chaos=FaultInjector(
+            seed=seed,
+            schedule=[{"point": "publish_transfer", "kind": "transfer_error"}],
+        ),
+    )
+    rec = pub.poll()
+    assert rec is not None and rec["action"] == "published"
+    assert engine.weights_version == 2
+    assert pub.stats()["aborted"] == 0
+    prompt = _prompts(cfg, [5], seed=19)[0]
+    variant_model = Model(module=model.module, params=_device_tree(variant_host))
+    np.testing.assert_array_equal(
+        engine.run([prompt], max_new_tokens=4)[0],
+        np.asarray(generate(variant_model, prompt[None], max_new_tokens=4))[0],
+    )
+
+
+def test_chaos_transfer_persistent_aborts_publish(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 2)
+    # u >= 0.75 is persistent: every retry fails, the publish aborts.
+    seed = next(s for s in range(64) if _transfer_u(s, 2) >= 0.75)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0,
+                      transfer_retries=1, backoff_s=0.0, backoff_cap_s=0.0),
+        chaos=FaultInjector(
+            seed=seed,
+            schedule=[{"point": "publish_transfer", "kind": "transfer_error"}],
+        ),
+    )
+    assert pub.poll() is None
+    stats = pub.stats()
+    assert stats["aborted"] == 1 and stats["published"] == 0
+    assert engine.weights_version == 0  # nothing half-bound
+    assert pub.history[-1]["action"] == "aborted"
+    assert pub.history[-1]["attempts"] == 2
+    assert "transfer_error" in pub.history[-1]["reason"]
+
+
+def test_chaos_slo_regression_rolls_back_and_quarantines(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 4)
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(
+            checkpoint_dir=str(tmp_path), canary_fraction=0.5,
+            canary_warmup=0, min_cohort=2,
+            max_ttft_ratio=100.0, max_tpot_ratio=100.0, max_rate_increase=1.0,
+        ),
+        chaos=FaultInjector(
+            seed=1,
+            schedule=[{"point": "canary_window", "kind": "slo_regression"}],
+        ),
+    )
+    prompts = _prompts(cfg, [4] * 8, seed=23)
+    ids = [engine.submit(p, max_new_tokens=3) for p in prompts]
+    rows, actions = _drain(engine, ids, publisher=pub)
+    assert [a["action"] for a in actions] == ["published", "rolled_back"]
+    assert actions[1]["reasons"] == ["injected slo_regression"]
+    assert engine.weights_version == 0
+    assert pub.stats()["rolled_back"] == 1
+
+    # The rolled-back version is quarantined: the still-newest-on-disk bad
+    # checkpoint is never republished; recovery needs a NEWER committed step.
+    for _ in range(3):
+        assert pub.poll() is None
+    assert pub.stats()["skipped_vetoed"] >= 1
+
+    # Post-rollback admissions are bit-equal to never having published.
+    check = _prompts(cfg, [5], seed=29)[0]
+    np.testing.assert_array_equal(
+        engine.run([check], max_new_tokens=4)[0],
+        np.asarray(generate(model, check[None], max_new_tokens=4))[0],
+    )
+
+    # A newer committed step recovers.
+    _write_ckpt(tmp_path, _variant(model.params, scale=1.5), 6)
+    rec = pub.poll()
+    assert rec["action"] == "published" and rec["version"] == 6
+
+
+# ---------------------------------------------------------------------------
+# Cross-topology publish (satellite: train 2x4 -> serving placement)
+# ---------------------------------------------------------------------------
+
+
+def test_cross_topology_publish_bit_equal(llama, tmp_path):
+    """A checkpoint carrying a 2x4 train-mesh plan manifest (dp_shard-sharded
+    leaves) publishes onto the serving placement through the reshard
+    planner's schedule and decodes bit-equal to a direct load."""
+    cfg, model = llama
+    variant_host = _variant(model.params)
+    flat = flatten_state_dict(variant_host)
+    leaves = {}
+    for name, arr in flat.items():
+        spec = ["dp_shard"] if arr.ndim >= 1 and arr.shape[0] % 2 == 0 else []
+        leaves[f"slot0/params/{name}"] = {
+            "shape": [int(d) for d in arr.shape],
+            "dtype": str(arr.dtype),
+            "spec": spec,
+        }
+    plan = {
+        "version": 1,
+        "weights_version": 2,
+        "world_size": 1,
+        "n_devices": 8,
+        "layout": {"dp_shard": 2, "tp": 4},
+        "mesh_axes": {"dp_shard": 2, "tp": 4},
+        "leaves": leaves,
+    }
+    _write_ckpt(tmp_path, variant_host, 2, plan=plan)
+
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0),
+    )
+    rec = pub.poll()
+    assert rec["action"] == "published" and rec["version"] == 2
+    # The topology gap is real: source-sharded leaves cost planned bytes.
+    assert rec["bytes"] > 0
+    stats = pub.stats()
+    assert stats["bytes_planned"] > 0
+    assert stats["bytes_moved"] > 0
+    assert stats["predicted_transfer_s"] > 0
+
+    prompt = _prompts(cfg, [6], seed=31)[0]
+    variant_model = Model(module=model.module, params=_device_tree(variant_host))
+    np.testing.assert_array_equal(
+        engine.run([prompt], max_new_tokens=5)[0],
+        np.asarray(generate(variant_model, prompt[None], max_new_tokens=5))[0],
+    )
+    assert engine.stats()["decode_executables"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Telemetry + chaos registry
+# ---------------------------------------------------------------------------
+
+
+class _StubTelemetry:
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, name, **fields):
+        self.events.append((name, fields))
+
+
+def test_publish_emits_weights_published_events(llama, tmp_path):
+    cfg, model = llama
+    _write_ckpt(tmp_path, _variant(model.params), 2)
+    telem = _StubTelemetry()
+    engine = _engine(model)
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(checkpoint_dir=str(tmp_path), canary_fraction=1.0),
+        telemetry=telem,
+    )
+    pub.poll()
+    events = [(n, f) for n, f in telem.events if n == "weights_published"]
+    assert len(events) == 1
+    assert events[0][1]["outcome"] == "cutover"
+    assert events[0][1]["version"] == 2
+
+
+def test_publish_chaos_points_registered():
+    # The three publication points accept their legal kinds...
+    FaultInjector(rates={
+        "publish_manifest": {"torn_write": 0.1, "version_mismatch": 0.1},
+        "publish_transfer": {"transfer_error": 0.1},
+        "canary_window": {"slo_regression": 0.1},
+    })
+    # ...and reject kinds that belong elsewhere.
+    with pytest.raises(ValueError):
+        FaultInjector(rates={"canary_window": {"torn_write": 0.1}})
+    with pytest.raises(ValueError):
+        FaultInjector(rates={"publish_transfer": {"slo_regression": 0.1}})
