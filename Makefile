@@ -7,7 +7,8 @@ PYTEST = $(ENV) python -m pytest -q
 .PHONY: chip_evidence test test_smoke test_core test_models test_parallel test_big_modeling \
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
-        reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke
+        reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
+        autoscale-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -138,6 +139,19 @@ chaos-train-smoke:
 # docs/usage_guides/serving.md "Continuous deployment".
 publish-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.publish_smoke
+
+# Elastic-serving gate: a seeded diurnal trace (10x rate swing, shifting
+# prompt:decode mix) replays through a disagg engine that starts on half
+# the mesh with an AutoscaleController polling every tick; mid-trace a
+# device is reported dead. Every request must end ok, every row bit-equal
+# to a fixed 8-device reference, the controller must grow AND shrink-on-
+# death within a bounded resize count, the injected flap must be damped
+# (no resize), decode keeps 0 steady recompiles across every layout, p95
+# TTFT holds the smoke SLO on both load plateaus, and a second seeded run
+# replays decisions/faults/rows bit-identically. See
+# docs/usage_guides/serving.md "Autoscaling".
+autoscale-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.autoscale_smoke
 
 # Auto-parallelism gate: plan a tiny Llama on the 8-device CPU mesh —
 # search must be deterministic (byte-identical JSON), every candidate must

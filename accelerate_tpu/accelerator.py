@@ -1755,6 +1755,32 @@ class Accelerator:
             engine, config, chaos=chaos, telemetry=self.telemetry,
         )
 
+    def build_autoscale_controller(self, engine, config=None, *,
+                                   device_pool=None, chaos=None):
+        """Construct an
+        :class:`~accelerate_tpu.autoscale.AutoscaleController` that closes
+        the telemetry → planner → live-resize loop over ``engine`` (a
+        :class:`~accelerate_tpu.disagg.DisaggServingEngine`): rolling-window
+        SLO signals sampled every ``poll_ticks``, hysteresis + consecutive-
+        breach + cooldown flap damping, a shared planner gate on every
+        proposed topology, and zero-downtime grow/shrink/re-split through
+        ``engine.resize`` (see :mod:`accelerate_tpu.autoscale`). Autoscaling
+        is OFF unless this controller is built and polled.
+
+        ``config`` is an :class:`~accelerate_tpu.autoscale.AutoscaleConfig`;
+        ``device_pool`` is the device set the controller may scale across
+        (defaults to the engine's current devices — no headroom);
+        ``chaos`` defaults to the engine's injector so one seeded schedule
+        covers serving, resize, and decision faults together."""
+        from .autoscale import AutoscaleController
+
+        if chaos is None:
+            chaos = getattr(engine, "chaos", None)
+        return AutoscaleController(
+            engine, config, device_pool=device_pool, chaos=chaos,
+            telemetry=self.telemetry,
+        )
+
     def _comm_hook_step(
         self,
         loss_fn,

@@ -1,0 +1,584 @@
+"""Telemetry-driven autoscaling for the serving stack (layer L7).
+
+The repo already has every piece of a self-operating engine — SLO-aware
+admission (serving.py), planner-sized disaggregation (disagg.py), elastic
+redistribution (resharding.py), and a zero-downtime param-swap seam
+(publish.py) — but nothing closes the loop: the engine rides a fixed
+prefill/decode split while queue depth, shed rates, and TTFT percentiles
+are recorded and ignored. This module is that loop, deliberately boring:
+
+- **Signals** — rolling-window (NOT lifetime) SLO aggregates from
+  ``ServingEngine.window_stats()``: queue depth p95, shed/timeout rates,
+  ok-only TTFT p95, and the observed prompt:decode ratio, sampled every
+  ``poll_ticks`` engine ticks.
+- **Decisions** — hysteresis bands around the targets
+  (``queue_depth_high``/``queue_depth_low``), ``breach_samples``
+  consecutive breached samples before acting, and ``cooldown_ticks`` after
+  every resize: one noisy sample (or an injected ``flap`` fault) can never
+  move the topology. Any proposed world size passes the SAME planner gate
+  as the gang supervisor's dead-host shrink
+  (:func:`~accelerate_tpu.planner.validate_world_size`, via
+  :func:`~accelerate_tpu.resharding.grow_world_size` /
+  :func:`~accelerate_tpu.resharding.shrink_world_size`) plus a
+  :func:`~accelerate_tpu.planner.plan_disagg_slices` consult under the
+  window's observed ratio. Every decision — including "hold" — lands in
+  ``history`` and telemetry naming the triggering signal.
+- **Actuation** — :meth:`DisaggServingEngine.resize`: the whole target
+  layout is built and pre-warmed before a one-swap commit, in-flight
+  decodes drain on the retired layout, and a failed resize aborts with the
+  old layout untouched.
+
+Determinism: every signal the policy reads is tick-deterministic (queue
+depth, terminal-status rates, token ratios) — never wall-clock — so a
+seeded trace replays the exact decision/resize sequence bit-identically
+(the ``make autoscale-smoke`` bar). ``ttft_p95_slo_s`` is the one
+wall-clock knob; it defaults to None (advisory, recorded in every
+decision) and turning it on trades replay determinism for latency-reactive
+scaling — the docstring on :class:`AutoscaleConfig` says so.
+
+Off by default everywhere: nothing constructs a controller unless you do
+(or call ``Accelerator.build_autoscale_controller``).
+
+Usage::
+
+    from accelerate_tpu import AutoscaleConfig, AutoscaleController
+
+    engine = DisaggServingEngine(model, cfg, disagg=dc, devices=pool[:4])
+    auto = AutoscaleController(engine, AutoscaleConfig(poll_ticks=16),
+                               device_pool=pool)
+    while engine.pending:
+        engine.tick()
+        auto.poll()                   # samples + decides every poll_ticks
+    auto.mark_device_dead(pool[2])    # health-check path: immediate shrink
+    auto.stats()                      # decisions/holds/grows/shrinks/aborts
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+from .logging import get_logger
+from .planner import PlannerError, plan_disagg_slices, validate_world_size
+from .resharding import grow_world_size, shrink_world_size
+
+logger = get_logger(__name__)
+
+__all__ = ["AutoscaleConfig", "AutoscaleController", "make_diurnal_trace"]
+
+
+def _log_ok() -> bool:
+    from .state import PartialState
+
+    return bool(PartialState._shared_state)
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Policy knobs for :class:`AutoscaleController`. The defaults are
+    deliberately conservative: two consecutive breached samples to act, a
+    long cooldown after every resize, and a bounded resize budget — an
+    autoscaler that flaps is worse than none.
+
+    - ``poll_ticks``: engine ticks between samples (the window needs time
+      to move between readings).
+    - ``window_min_requests``: hold (``window_thin``) until the rolling
+      window holds at least this many terminal requests.
+    - ``queue_depth_high`` / ``queue_depth_low``: the hysteresis band
+      around the queue-depth p95 signal — above the high edge reads as
+      overload (grow), below the low edge as idle capacity (shrink),
+      between them the topology holds. Any window shedding above
+      ``shed_rate_high`` also reads as overload.
+    - ``breach_samples``: consecutive breached samples required before a
+      resize — one noisy sample (or an injected ``flap``) is damped.
+    - ``cooldown_ticks``: no load-driven resize within this many ticks of
+      the previous one (dead-device shrinks are correctness, not load, and
+      skip the cooldown).
+    - ``resplit_tolerance``: relative drift between the window's observed
+      prompt:decode ratio and the active plan's before an in-place
+      re-split is considered.
+    - ``min_devices`` / ``max_devices`` / ``max_resizes``: hard bounds on
+      the actuator (disaggregation needs >= 2 devices).
+    - ``layout``: recorded parallel layout handed to the shared
+      :func:`~accelerate_tpu.planner.validate_world_size` gate.
+    - ``ttft_p95_slo_s``: optional wall-clock TTFT SLO. None (default)
+      keeps decisions fully tick-deterministic — the value is still
+      recorded in every decision for observability; setting it makes a
+      window TTFT p95 above it read as overload, trading bit-identical
+      replay for latency-reactive scaling.
+    """
+
+    poll_ticks: int = 16
+    window_min_requests: int = 8
+    queue_depth_high: float = 4.0
+    queue_depth_low: float = 0.5
+    shed_rate_high: float = 0.0
+    breach_samples: int = 2
+    cooldown_ticks: int = 64
+    resplit_tolerance: float = 0.5
+    min_devices: int = 2
+    max_devices: Optional[int] = None
+    max_resizes: Optional[int] = None
+    layout: Optional[dict] = None
+    ttft_p95_slo_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.poll_ticks < 1:
+            raise ValueError("poll_ticks must be >= 1")
+        if self.window_min_requests < 1:
+            raise ValueError("window_min_requests must be >= 1")
+        if not 0 <= self.queue_depth_low < self.queue_depth_high:
+            raise ValueError(
+                "need 0 <= queue_depth_low < queue_depth_high, got "
+                f"{self.queue_depth_low} / {self.queue_depth_high}"
+            )
+        if self.shed_rate_high < 0:
+            raise ValueError("shed_rate_high must be >= 0")
+        if self.breach_samples < 1:
+            raise ValueError("breach_samples must be >= 1")
+        if self.cooldown_ticks < 0:
+            raise ValueError("cooldown_ticks must be >= 0")
+        if not self.resplit_tolerance > 0:
+            raise ValueError("resplit_tolerance must be > 0")
+        if self.min_devices < 2:
+            raise ValueError("min_devices must be >= 2 (disaggregation "
+                             "needs a prefill and a decode slice)")
+        if self.max_devices is not None and self.max_devices < self.min_devices:
+            raise ValueError("max_devices must be >= min_devices (or None)")
+        if self.max_resizes is not None and self.max_resizes < 0:
+            raise ValueError("max_resizes must be >= 0 (or None)")
+        if self.ttft_p95_slo_s is not None and not self.ttft_p95_slo_s > 0:
+            raise ValueError("ttft_p95_slo_s must be > 0 (or None)")
+
+
+class AutoscaleController:
+    """Closes the telemetry → planner → live-resize loop over one
+    :class:`~accelerate_tpu.disagg.DisaggServingEngine`. Call
+    :meth:`poll` between engine ticks (it is a no-op except every
+    ``poll_ticks``); call :meth:`mark_device_dead` from a health check to
+    shrink off a lost device immediately. OFF unless constructed — the
+    engine never resizes itself."""
+
+    def __init__(self, engine, config: Optional[AutoscaleConfig] = None, *,
+                 device_pool=None, chaos=None, telemetry=None):
+        if not hasattr(engine, "resize"):
+            raise ValueError(
+                "AutoscaleController needs an engine with a live resize "
+                "actuator (DisaggServingEngine); the colocated ServingEngine "
+                "has no topology to re-split."
+            )
+        self.engine = engine
+        self.config = config if config is not None else AutoscaleConfig()
+        self.chaos = chaos
+        self.telemetry = telemetry
+        pool = (list(device_pool) if device_pool is not None
+                else list(engine._devices))
+        for d in engine._devices:
+            if d not in pool:
+                raise ValueError(
+                    f"engine device {d} is not in the controller's device "
+                    "pool — the pool must cover the active set"
+                )
+        self.pool = pool
+        self.dead: set = set()
+        self.history: list[dict] = []
+        self._last_sample_tick: Optional[int] = None
+        self._breach_over = 0
+        self._breach_under = 0
+        self._cooldown_until = 0
+        self._stats = {
+            "samples": 0, "decisions": 0, "holds": 0, "grows": 0,
+            "shrinks": 0, "resplits": 0, "dead_device_shrinks": 0,
+            "resizes": 0, "aborts": 0, "flap_damped": 0, "spikes": 0,
+            "planner_refusals": 0,
+        }
+
+    # -- signals -----------------------------------------------------------
+
+    def poll(self) -> Optional[dict]:
+        """Sample the rolling window and decide, once per ``poll_ticks``
+        engine ticks. Returns the decision record (also appended to
+        ``history``) on sampling ticks, None otherwise."""
+        tick = int(self.engine._stats["ticks"])
+        c = self.config
+        last = self._last_sample_tick
+        if (last is None and tick < c.poll_ticks) or \
+                (last is not None and tick - last < c.poll_ticks):
+            return None
+        self._last_sample_tick = tick
+        return self._decide(tick, self._sample(tick))
+
+    def _sample(self, tick: int) -> dict:
+        self._stats["samples"] += 1
+        w = self.engine.window_stats()
+        sample = {
+            "tick": tick,
+            "requests": int(w["requests"]),
+            "queue_depth_p95": float(w["queue_depth_p95"] or 0.0),
+            "shed_rate": float(w["shed_rate"]),
+            "timeout_rate": float(w["timeout_rate"]),
+            "ttft_p95_s": w["ttft_p95_s"],
+            "prompt_decode_ratio": w["prompt_decode_ratio"],
+            "spike": False,
+        }
+        if self.chaos is not None:
+            fault = self.chaos.draw("load_spike", tick, unit=0)
+            if fault is not None and fault.kind == "spike":
+                # A synthetic spike: the sample reads as hard overload. The
+                # decision path downstream is the REAL grow path — damping,
+                # planner consult, resize — exercised without real load.
+                self._stats["spikes"] += 1
+                sample["spike"] = True
+                sample["queue_depth_p95"] = max(
+                    sample["queue_depth_p95"],
+                    4.0 * float(self.config.queue_depth_high))
+        return sample
+
+    # -- decisions ---------------------------------------------------------
+
+    def _decide(self, tick: int, sample: dict) -> dict:
+        c = self.config
+        qd = sample["queue_depth_p95"]
+        ttft_breach = (c.ttft_p95_slo_s is not None
+                       and sample["ttft_p95_s"] is not None
+                       and sample["ttft_p95_s"] > c.ttft_p95_slo_s)
+        over = (qd > c.queue_depth_high
+                or sample["shed_rate"] > c.shed_rate_high or ttft_breach)
+        under = (qd < c.queue_depth_low
+                 and sample["shed_rate"] <= c.shed_rate_high
+                 and sample["timeout_rate"] == 0.0 and not ttft_breach)
+        if over:
+            signal = ("shed_rate" if sample["shed_rate"] > c.shed_rate_high
+                      else "ttft_p95_s" if ttft_breach else "queue_depth_p95")
+        elif under:
+            signal = "queue_depth_p95"
+        else:
+            signal = "in_band"
+        flap = False
+        if self.chaos is not None:
+            fault = self.chaos.draw("autoscale_decide", tick, unit=0)
+            if fault is not None and fault.kind == "flap":
+                # The injected flap inverts this ONE sample's band reading;
+                # only the consecutive-breach damper stands between it and
+                # a spurious resize.
+                over, under = under, over
+                flap = True
+                signal = f"flap({signal})"
+
+        if sample["requests"] < c.window_min_requests:
+            self._breach_over = self._breach_under = 0
+            return self._record(
+                tick, "hold", "window_thin", sample, flap=flap,
+                reason=(f"window holds {sample['requests']} < "
+                        f"{c.window_min_requests} requests"))
+        self._breach_over = self._breach_over + 1 if over else 0
+        self._breach_under = self._breach_under + 1 if under else 0
+        in_cooldown = tick < self._cooldown_until
+
+        if over and self._breach_over >= c.breach_samples:
+            if in_cooldown:
+                return self._record(
+                    tick, "hold", signal, sample, flap=flap,
+                    reason=f"cooldown until tick {self._cooldown_until}")
+            return self._try_grow(tick, signal, sample, flap)
+        if under and self._breach_under >= c.breach_samples:
+            if in_cooldown:
+                return self._record(
+                    tick, "hold", signal, sample, flap=flap,
+                    reason=f"cooldown until tick {self._cooldown_until}")
+            return self._try_shrink(tick, signal, sample, flap)
+        if over or under:
+            n = self._breach_over if over else self._breach_under
+            return self._record(
+                tick, "hold", signal, sample, flap=flap,
+                reason=(f"breach {n}/{c.breach_samples} consecutive "
+                        "samples — damped"))
+        if not in_cooldown:
+            resplit = self._maybe_resplit(tick, sample, flap)
+            if resplit is not None:
+                return resplit
+        return self._record(tick, "hold", signal, sample, flap=flap,
+                            reason="signals inside the hysteresis band")
+
+    def _resize_budget_spent(self) -> bool:
+        return (self.config.max_resizes is not None
+                and self._stats["resizes"] >= self.config.max_resizes)
+
+    def _ratio(self, sample: dict) -> float:
+        r = sample.get("prompt_decode_ratio")
+        return float(r) if r else float(self.engine.slice_plan.flop_ratio)
+
+    def _pick_devices(self, n: int) -> list:
+        """Target device set: keep the current (surviving) set stable,
+        extend from the pool's spares — minimizes what the resize moves."""
+        cur = [d for d in self.engine._devices if d not in self.dead]
+        extra = [d for d in self.pool
+                 if d not in self.dead and d not in cur]
+        return (cur + extra)[:n]
+
+    def _consult_planner(self, n: int, ratio: float) -> Optional[str]:
+        """The shared topology gate every proposal passes BEFORE the
+        actuator is touched: the world size must validate
+        (:func:`planner.validate_world_size`, same helper as the gang
+        supervisor's dead-host shrink) and the disagg split must plan
+        under the observed ratio. Returns a refusal reason or None."""
+        if not validate_world_size(n, self.config.layout):
+            return f"validate_world_size refused {n} devices"
+        try:
+            plan_disagg_slices(n, prefill_decode_flop_ratio=ratio)
+        except PlannerError as e:
+            return f"planner refused {n} devices: {e}"
+        return None
+
+    def _try_grow(self, tick: int, signal: str, sample: dict,
+                  flap: bool) -> dict:
+        c = self.config
+        if self._resize_budget_spent():
+            return self._record(tick, "hold", signal, sample, flap=flap,
+                                reason=f"resize budget ({c.max_resizes}) spent")
+        n_active = len(self.engine._devices)
+        avail = [d for d in self.pool if d not in self.dead]
+        cap = min(len(avail), c.max_devices or len(avail))
+        if cap - n_active <= 0:
+            return self._record(tick, "hold", signal, sample, flap=flap,
+                                reason="no spare devices in the pool")
+        target = grow_world_size(n_active, gained=cap - n_active,
+                                 layout=c.layout)
+        if target is None or target > cap:
+            self._stats["planner_refusals"] += 1
+            return self._record(
+                tick, "hold", signal, sample, flap=flap,
+                reason=f"no viable larger size above {n_active}")
+        ratio = self._ratio(sample)
+        refused = self._consult_planner(target, ratio)
+        if refused:
+            self._stats["planner_refusals"] += 1
+            return self._record(tick, "hold", signal, sample, flap=flap,
+                                reason=refused)
+        return self._actuate(tick, "grow", signal, sample, flap,
+                             self._pick_devices(target), ratio)
+
+    def _try_shrink(self, tick: int, signal: str, sample: dict,
+                    flap: bool) -> dict:
+        c = self.config
+        if self._resize_budget_spent():
+            return self._record(tick, "hold", signal, sample, flap=flap,
+                                reason=f"resize budget ({c.max_resizes}) spent")
+        n_active = len(self.engine._devices)
+        target = shrink_world_size(n_active, lost=1, layout=c.layout)
+        if target is None or target < c.min_devices:
+            return self._record(
+                tick, "hold", signal, sample, flap=flap,
+                reason=f"already at min_devices ({n_active} active)")
+        ratio = self._ratio(sample)
+        refused = self._consult_planner(target, ratio)
+        if refused:
+            self._stats["planner_refusals"] += 1
+            return self._record(tick, "hold", signal, sample, flap=flap,
+                                reason=refused)
+        return self._actuate(tick, "shrink", signal, sample, flap,
+                             self._pick_devices(target), ratio)
+
+    def _maybe_resplit(self, tick: int, sample: dict,
+                       flap: bool) -> Optional[dict]:
+        """In-band and out of cooldown: if the window's observed
+        prompt:decode ratio drifted past ``resplit_tolerance`` AND the
+        planner wants a different split at the SAME device count, re-split
+        in place. Returns None when there is nothing to do (the common
+        case — the caller then records a plain hold)."""
+        ratio = sample.get("prompt_decode_ratio")
+        if not ratio or self._resize_budget_spent():
+            return None
+        cur = float(self.engine.slice_plan.flop_ratio)
+        if abs(float(ratio) - cur) / max(cur, 1e-9) <= \
+                self.config.resplit_tolerance:
+            return None
+        n_active = len(self.engine._devices)
+        try:
+            plan = plan_disagg_slices(
+                n_active, prefill_decode_flop_ratio=float(ratio))
+        except PlannerError:
+            return None
+        if plan.n_prefill == self.engine.slice_plan.n_prefill:
+            return None
+        return self._actuate(tick, "resplit", "prompt_decode_ratio", sample,
+                             flap, self._pick_devices(n_active),
+                             float(ratio))
+
+    # -- actuation ---------------------------------------------------------
+
+    def _actuate(self, tick: int, action: str, signal: str, sample: dict,
+                 flap: bool, devices: list, ratio: float) -> dict:
+        rec = self.engine.resize(devices=devices, flop_ratio=ratio,
+                                 dead_devices=self.dead)
+        self._cooldown_until = tick + int(self.config.cooldown_ticks)
+        self._breach_over = self._breach_under = 0
+        if rec.get("ok"):
+            self._stats["resizes"] += 1
+            self._stats[{"grow": "grows", "shrink": "shrinks",
+                         "resplit": "resplits"}[action]] += 1
+            reason = (f"{signal} breached {self.config.breach_samples} "
+                      f"consecutive samples" if action != "resplit" else
+                      f"observed ratio {ratio:.3g} vs plan "
+                      f"{self.engine.slice_plan.flop_ratio:.3g}")
+            return self._record(tick, action, signal, sample, flap=flap,
+                                reason=reason, resize=rec)
+        self._stats["aborts"] += 1
+        return self._record(tick, f"{action}_aborted", signal, sample,
+                            flap=flap, reason=rec.get("reason", "resize "
+                            "aborted"), resize=rec)
+
+    def mark_device_dead(self, device) -> Optional[dict]:
+        """Health-check path: ``device`` is gone. A dead ACTIVE device
+        shrinks immediately — correctness, not load, so neither the
+        cooldown nor the breach damper applies (the resize budget still
+        does not: survival beats quota). The surviving exact count is used
+        when the shared planner gate validates it, else the largest viable
+        smaller size. A dead spare is only recorded."""
+        self.dead.add(device)
+        tick = int(self.engine._stats["ticks"])
+        if device not in self.engine._devices:
+            return self._record(
+                tick, "hold", "dead_device", None,
+                reason=f"dead device {device} was a spare")
+        n_active = len(self.engine._devices)
+        survivors = n_active - 1
+        if validate_world_size(survivors, self.config.layout) and \
+                self._consult_planner(
+                    survivors, float(self.engine.slice_plan.flop_ratio)) is None:
+            target = survivors
+        else:
+            target = shrink_world_size(n_active, lost=1,
+                                       layout=self.config.layout)
+        if target is None or target < 2:
+            self._stats["planner_refusals"] += 1
+            return self._record(
+                tick, "hold", "dead_device", None,
+                reason=(f"no viable size below {n_active} — engine keeps "
+                        "serving degraded"))
+        rec = self.engine.resize(devices=self._pick_devices(target),
+                                 dead_devices=self.dead)
+        self._cooldown_until = tick + int(self.config.cooldown_ticks)
+        self._breach_over = self._breach_under = 0
+        if rec.get("ok"):
+            self._stats["resizes"] += 1
+            self._stats["shrinks"] += 1
+            self._stats["dead_device_shrinks"] += 1
+            return self._record(tick, "shrink", "dead_device", None,
+                                reason=f"device {device} died", resize=rec)
+        self._stats["aborts"] += 1
+        return self._record(tick, "shrink_aborted", "dead_device", None,
+                            reason=rec.get("reason", "resize aborted"),
+                            resize=rec)
+
+    # -- reporting ---------------------------------------------------------
+
+    def _record(self, tick: int, action: str, signal: str,
+                sample: Optional[dict], *, reason: str, flap: bool = False,
+                resize: Optional[dict] = None) -> dict:
+        self._stats["decisions"] += 1
+        if action == "hold":
+            self._stats["holds"] += 1
+            if flap:
+                # The flap fired and nothing moved — the damper absorbed it.
+                self._stats["flap_damped"] += 1
+        rec = {
+            "tick": tick, "action": action, "signal": signal,
+            "reason": reason, "flap_injected": flap,
+            "active_devices": len(self.engine._devices),
+        }
+        if sample is not None:
+            rec["sample"] = dict(sample)
+        if resize is not None:
+            rec["resize"] = dict(resize)
+        self.history.append(rec)
+        if _log_ok() and action != "hold":
+            logger.info("autoscale: tick %d %s (%s — %s)", tick, action,
+                        signal, reason)
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_event(
+                    "autoscale_decision", tick=tick, action=action,
+                    signal=signal, reason=reason, flap_injected=flap,
+                    active_devices=rec["active_devices"],
+                    ttft_p95_slo_s=self.config.ttft_p95_slo_s,
+                )
+            except Exception:
+                pass  # observability must never kill the control loop
+        return rec
+
+    def stats(self) -> dict:
+        """The ``autoscale`` telemetry block: decision/resize counters plus
+        the live control state (bench rows and ``make autoscale-smoke``
+        embed this verbatim)."""
+        out = dict(self._stats)
+        out["active_devices"] = len(self.engine._devices)
+        out["pool_devices"] = len(self.pool)
+        out["dead_devices"] = len(self.dead)
+        out["cooldown_until_tick"] = self._cooldown_until
+        out["breach_over"] = self._breach_over
+        out["breach_under"] = self._breach_under
+        last = next((h for h in reversed(self.history)
+                     if h["action"] != "hold"), None)
+        out["last_action"] = (
+            {k: last[k] for k in ("tick", "action", "signal", "reason")}
+            if last is not None else None)
+        return out
+
+    def close(self) -> None:
+        """Flush the autoscale summary into the telemetry stream."""
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_autoscale(self.stats())
+            except Exception as e:
+                logger.warning_once(f"autoscale: telemetry summary failed: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Seeded diurnal load trace (shared by benchmarks and the autoscale smoke)
+# ---------------------------------------------------------------------------
+
+
+def make_diurnal_trace(n_requests: int = 64, *, seed: int = 0,
+                       swing: float = 10.0, base_rate: float = 1.0,
+                       short_prompt=(8, 24), long_prompt=(32, 64),
+                       short_budget=(4, 8), long_budget=(12, 24),
+                       vocab_size: int = 256) -> dict:
+    """Deterministic diurnal arrival trace: three plateaus (low, high, low
+    — a compressed day) whose offered rate swings by ``swing``x and whose
+    prompt:decode mix shifts with it (the high plateau sends long prompts
+    with short continuations — prefill-heavy; the low plateaus the
+    opposite), so an autoscaler must both grow AND re-split to ride it.
+    Everything is drawn from one seeded generator: the same seed yields
+    the same arrivals, prompts, and budgets, byte for byte.
+
+    Returns ``{"arrivals", "phases", "prompts", "budgets", "lengths"}`` —
+    arrivals in abstract time units (scale by your tick or wall-clock
+    rate), phases 0/1/2 per request."""
+    rng = np.random.default_rng(seed)
+    n = int(n_requests)
+    if n < 4:
+        raise ValueError("n_requests must be >= 4 (three plateaus)")
+    n1 = n // 4
+    n2 = n // 2
+    phases = np.concatenate([
+        np.zeros(n1, np.int64), np.ones(n2, np.int64),
+        np.full(n - n1 - n2, 2, np.int64),
+    ])
+    rates = np.where(phases == 1, float(base_rate) * float(swing),
+                     float(base_rate))
+    arrivals = np.cumsum(rng.exponential(1.0, n) / rates)
+    lengths = np.empty(n, np.int64)
+    budgets = np.empty(n, np.int64)
+    for i in range(n):
+        plo, phi = long_prompt if phases[i] == 1 else short_prompt
+        blo, bhi = short_budget if phases[i] == 1 else long_budget
+        lengths[i] = rng.integers(plo, phi + 1)
+        budgets[i] = rng.integers(blo, bhi + 1)
+    prompts = [rng.integers(1, int(vocab_size), (int(L),), dtype=np.int32)
+               for L in lengths]
+    return {"arrivals": arrivals, "phases": phases, "prompts": prompts,
+            "budgets": [int(b) for b in budgets],
+            "lengths": [int(x) for x in lengths]}

@@ -77,6 +77,22 @@ constructed with ``chaos=...``):
   (``slo_regression`` forces the decision to read as a regression, driving
   the bit-equal auto-rollback path).
 
+Autoscaling injection points (drawn by ``autoscale.AutoscaleController``
+and the disagg router's live resize when constructed with ``chaos=...``):
+
+- ``autoscale_decide`` — the per-sample scaling decision (``tick`` = the
+  engine tick the sample was taken at); ``flap`` inverts that one sample's
+  hysteresis-band reading, so only the consecutive-breach damper stands
+  between one noisy sample and a spurious resize;
+- ``resize_transfer`` — the old→new layout param redistribution inside
+  ``DisaggServingEngine.resize`` (``tick`` = resize sequence number;
+  ``transfer_error``: ``u < 0.75`` transient — one retry heals it — else
+  persistent, exhausting the retry budget and aborting the resize with the
+  old layout untouched; ``delay`` adds a backoff-shaped stall);
+- ``load_spike`` — a synthetic load spike at sampling time (``spike``
+  inflates the sample's queue-depth/shed signals, exercising the grow path
+  without needing real overload in a smoke).
+
 Off by default everywhere: no injector exists unless you construct one and
 pass it to an engine (``ServingEngine(..., chaos=...)``) or to
 ``FaultToleranceKwargs(chaos=...)``; the import is lazy-safe (numpy only)
@@ -128,12 +144,16 @@ INJECTION_POINTS = (
     "publish_manifest",
     "publish_transfer",
     "canary_window",
+    # autoscaling (autoscale.py + the disagg live resize)
+    "autoscale_decide",
+    "resize_transfer",
+    "load_spike",
 )
 
 FAULT_KINDS = (
     "transfer_error", "delay", "dead_lane", "poison",
     "nonfinite_grad", "slow_step", "torn_write", "corrupt_batch", "dead_host",
-    "slo_regression", "version_mismatch",
+    "slo_regression", "version_mismatch", "flap", "spike",
 )
 
 # An injected dead host exits 139 (128 + SIGSEGV) unless the schedule entry
@@ -160,6 +180,13 @@ _POINT_KINDS = {
     "publish_manifest": ("torn_write", "version_mismatch"),
     "publish_transfer": ("transfer_error",),
     "canary_window": ("slo_regression",),
+    # Autoscaling (autoscale.py): a flap inverts one sample's band reading
+    # (the consecutive-breach damper must absorb it), a spike inflates one
+    # sample's load signals, and a resize transfer_error/delay drives the
+    # live resize's retry/backoff -> clean-abort path.
+    "autoscale_decide": ("flap",),
+    "resize_transfer": ("transfer_error", "delay"),
+    "load_spike": ("spike",),
 }
 
 _MASK = (1 << 64) - 1

@@ -70,8 +70,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, SingleDeviceSh
 from .chaos import InjectedFaultError, deterministic_jitter
 from .generation import KVCache, init_slot_cache
 from .logging import get_logger
-from .planner import BandwidthTable, kv_bytes_per_token, plan_disagg_slices
-from .serving import ServingEngine, SlotState, _cache_size, init_slot_state
+from .planner import (BandwidthTable, PlannerError, kv_bytes_per_token,
+                      plan_disagg_slices)
+from .resharding import ReshardExecutor
+from .serving import (ServingEngine, SlotState, _cache_size, _release_step,
+                      init_slot_state, plan_chunks)
 
 logger = get_logger(__name__)
 
@@ -115,6 +118,21 @@ class _Handoff:
                           # await the transfer and proceed
 
 
+@dataclass
+class _DrainingLayout:
+    """A retired topology still finishing its in-flight decodes after a live
+    resize. The old decode cache/state and every param version it might
+    reference stay bound here (and ONLY here) until ``decoding`` empties —
+    then the whole layout drops and its buffers go with it. Draining slots
+    index THIS layout's state, never the active free list."""
+
+    layout_id: int
+    cache: KVCache
+    state: SlotState
+    params_by_version: dict
+    decoding: dict          # slot -> request, frozen membership, drains down
+
+
 class DisaggServingEngine(ServingEngine):
     """Two-mesh router over the continuous-batching engine: chunked prefill
     on a planner-sized prefill slice, the zero-recompile decode step on the
@@ -151,6 +169,17 @@ class DisaggServingEngine(ServingEngine):
         # colocated on the decode mesh (correct, slower — traffic survives).
         self._quarantined_lanes: set[int] = set()
         self._degraded = False
+        # Live-resize state (autoscale.py drives this): the ordered device
+        # set the engine currently runs on, retired layouts still draining
+        # their in-flight decodes, and the resize telemetry counters.
+        self._devices = devs
+        self._resize_seq = 0
+        self._draining_layouts: list[_DrainingLayout] = []
+        self._rstats = {
+            "resizes": 0, "resize_aborts": 0, "resize_retries": 0,
+            "resize_delays": 0, "drained_layouts": 0, "rebound_requests": 0,
+            "retried_decodes": 0, "moved_bytes": 0, "transfer_wall_s": 0.0,
+        }
 
         # -- slice sizing (planner cost model) -----------------------------
         ratio = dc.prefill_decode_flop_ratio
@@ -177,23 +206,8 @@ class DisaggServingEngine(ServingEngine):
         # PRNG-key arrays under a multi-device NamedSharding occupy two
         # dispatch-cache entries per program in jax 0.4.37, so init
         # pre-warms both and the census reads a flat 2.
-        n_d = len(self.decode_devices)
-        if dc.shard_decode_slots and n_d > 1 and self.n_slots % n_d == 0:
-            self._decode_mesh = Mesh(
-                np.asarray(self.decode_devices), ("slots",))
-            cache_s = NamedSharding(self._decode_mesh, P(None, "slots"))
-            vec_s = NamedSharding(self._decode_mesh, P("slots"))
-            self._decode_sharding = NamedSharding(self._decode_mesh, P())
-        else:
-            if dc.shard_decode_slots and _log_ok():
-                logger.warning_once(
-                    "disagg: shard_decode_slots needs n_slots (%d) divisible "
-                    "by the decode slice (%d devices); falling back to "
-                    "single-device decode placement.", self.n_slots, n_d,
-                )
-            self._decode_mesh = None
-            cache_s = vec_s = self._decode_sharding = SingleDeviceSharding(
-                self.decode_devices[0])
+        (self._decode_mesh, cache_s, vec_s,
+         self._decode_sharding) = self._decode_placement(self.decode_devices)
         self._cache = jax.device_put(
             self._cache, KVCache(cache_s, cache_s, vec_s))
         self._state = jax.device_put(
@@ -298,6 +312,25 @@ class DisaggServingEngine(ServingEngine):
                 len(self._lanes), self.slice_plan.handoff_gbps,
             )
 
+    def _decode_placement(self, decode_devices) -> tuple:
+        """``(mesh, cache_sharding, vec_sharding, scalar_sharding)`` for a
+        decode slice — shared by construction and the live resize so both
+        layouts obey the same one-executable placement rules."""
+        dc = self.disagg_config
+        n_d = len(decode_devices)
+        if dc.shard_decode_slots and n_d > 1 and self.n_slots % n_d == 0:
+            mesh = Mesh(np.asarray(decode_devices), ("slots",))
+            return (mesh, NamedSharding(mesh, P(None, "slots")),
+                    NamedSharding(mesh, P("slots")), NamedSharding(mesh, P()))
+        if dc.shard_decode_slots and _log_ok():
+            logger.warning_once(
+                "disagg: shard_decode_slots needs n_slots (%d) divisible "
+                "by the decode slice (%d devices); falling back to "
+                "single-device decode placement.", self.n_slots, n_d,
+            )
+        single = SingleDeviceSharding(decode_devices[0])
+        return None, single, single, single
+
     # -- router scheduling -------------------------------------------------
 
     def tick(self) -> None:
@@ -310,8 +343,7 @@ class DisaggServingEngine(ServingEngine):
         prefills head-of-line colocated on the decode mesh instead."""
         snap = self._begin_tick()
         self._admit()
-        self._stats["queue_depth_sum"] += len(self._queue)
-        self._stats["queue_samples"] += 1
+        self._sample_queue_depth()
         self._drain_handoffs()
         if not self._degraded:
             self._assign_lanes()
@@ -330,6 +362,7 @@ class DisaggServingEngine(ServingEngine):
                     self._prefill_one(req)
         if self._decoding:
             self._decode_tick()
+        self._drain_decode_tick()
         self._end_tick(snap)
 
     def _assign_lanes(self) -> None:
@@ -559,6 +592,352 @@ class DisaggServingEngine(ServingEngine):
             jax.block_until_ready(k_page)
             self._handoff_lat_s.append(time.perf_counter() - h.t0)
 
+    # -- live resize (the autoscale.py actuator) ---------------------------
+
+    def resize(self, devices=None, *, n_prefill=None, flop_ratio=None,
+               dead_devices=()) -> dict:
+        """Live re-split / grow / shrink with zero downtime: build the whole
+        target layout (plan, decode placement, param copies for EVERY
+        installed version, lanes, pre-warmed executables) BEFORE touching
+        live state, then commit in one host-side swap. In-flight decodes
+        keep draining on the old layout (:class:`_DrainingLayout`);
+        mid-prefill requests re-queue at the head WITHOUT spending a retry
+        (their per-request rng replays bit-equal); new admissions bind the
+        new layout. A failure anywhere before the commit — planner refusal,
+        an injected/real ``resize_transfer`` error surviving the
+        ``handoff_retries`` budget — aborts with the old layout untouched
+        and nothing half-bound.
+
+        ``devices`` defaults to the current set minus ``dead_devices``;
+        ``flop_ratio`` (the observed prompt:decode ratio) re-runs the
+        planner split; ``n_prefill`` pins it. Returns a record dict
+        (``{"ok": bool, ...}``) that also lands in telemetry."""
+        dc = self.disagg_config
+        dead = set(dead_devices)
+        devs = (list(devices) if devices is not None
+                else [d for d in self._devices if d not in dead])
+        seq = self._resize_seq
+        self._resize_seq += 1
+        old_n = len(self._devices)
+
+        def abort(reason: str) -> dict:
+            self._rstats["resize_aborts"] += 1
+            if _log_ok():
+                logger.warning(
+                    "disagg: resize %d -> %d devices ABORTED (%s) — old "
+                    "layout keeps serving", old_n, len(devs), reason,
+                )
+            rec = {"ok": False, "seq": seq, "reason": reason,
+                   "n_devices": len(devs), "layout_id": self._active_layout_id}
+            if self.telemetry is not None:
+                try:
+                    self.telemetry.record_event(
+                        "serving_resize_aborted", seq=seq, reason=reason,
+                        n_devices=len(devs))
+                except Exception:
+                    pass
+            return rec
+
+        # -- validate + plan (nothing live touched yet) --------------------
+        if any(d in dead for d in devs):
+            return abort("target includes a dead device")
+        if len(devs) < 2:
+            return abort(f"needs >= 2 devices, got {len(devs)}")
+        ratio = (float(flop_ratio) if flop_ratio is not None
+                 else float(self.slice_plan.flop_ratio))
+        try:
+            kvb = kv_bytes_per_token(self.cfg, dtype=self._cache.k.dtype)
+            plan = plan_disagg_slices(
+                len(devs), prefill_decode_flop_ratio=ratio,
+                bw=BandwidthTable.from_dict(dc.bandwidths),
+                kv_bytes_per_token=kvb, n_prefill=n_prefill,
+            )
+        except PlannerError as e:
+            return abort(f"planner refused: {e}")
+
+        new_prefill = devs[:plan.n_prefill]
+        new_decode = devs[plan.n_prefill:]
+        mesh, cache_s, vec_s, dsh = self._decode_placement(new_decode)
+
+        # -- param redistribution across the topology gap ------------------
+        # The reshard executor prices and batches the copies; donate=False
+        # keeps the OLD layout's buffers alive for its draining requests.
+        # One chaos draw per resize at ``resize_transfer`` (tick = seq), the
+        # same transient-vs-persistent retry convention as the handoff path.
+        fault = None
+        if self.chaos is not None:
+            fault = self.chaos.draw("resize_transfer", seq, unit=0)
+        if fault is not None and fault.kind == "delay":
+            self._rstats["resize_delays"] += 1
+            time.sleep(min(float(dc.handoff_backoff_cap_s),
+                           float(dc.handoff_backoff_s)
+                           * int(self.chaos.delay_ticks)))
+            fault = None
+        executor = ReshardExecutor(Mesh(np.asarray(new_decode), ("decode",)))
+        t0 = time.perf_counter()
+        new_params_by_version = None
+        attempts = int(dc.handoff_retries) + 1
+        for attempt in range(attempts):
+            try:
+                if (fault is not None and fault.kind == "transfer_error"
+                        and (attempt == 0 or fault.u >= 0.75)):
+                    raise InjectedFaultError(fault)
+                new_params_by_version = {
+                    v: executor.put_tree(
+                        p, jax.tree_util.tree_map(lambda _: dsh, p),
+                        donate=False)
+                    for v, p in self._params_by_version.items()
+                }
+                break
+            except RuntimeError as e:
+                if attempt == attempts - 1:
+                    return abort(f"param transfer failed {attempts}x: {e}")
+                self._rstats["resize_retries"] += 1
+                backoff = min(
+                    float(dc.handoff_backoff_s) * (2 ** attempt),
+                    float(dc.handoff_backoff_cap_s),
+                ) * deterministic_jitter(
+                    self.chaos.seed if self.chaos is not None else 0,
+                    seq, attempt,
+                )
+                if backoff > 0:
+                    time.sleep(backoff)
+        ex_stats = executor.stats()
+        self._rstats["moved_bytes"] += int(ex_stats["bytes"])
+        self._rstats["transfer_wall_s"] += time.perf_counter() - t0
+
+        # -- build the rest of the target layout ---------------------------
+        new_cache = jax.device_put(
+            init_slot_cache(self.cfg, self.n_slots, self.t_max,
+                            dtype=self.config.cache_dtype),
+            KVCache(cache_s, cache_s, vec_s))
+        new_state = jax.device_put(
+            init_slot_state(self.n_slots, seed=self.config.seed),
+            SlotState(*([vec_s] * len(SlotState._fields))))
+        new_lane_params: dict[int, dict] = {}
+        for v, p in new_params_by_version.items():
+            by_dev: dict = {}
+            for i in range(int(dc.n_prefill_lanes)):
+                dev = new_prefill[i % len(new_prefill)]
+                if dev not in by_dev:
+                    by_dev[dev] = jax.device_put(p, dev)
+            new_lane_params[v] = by_dev
+        primary_lane_params = new_lane_params[self._weights_version]
+        new_lanes = [
+            _Lane(index=i, device=new_prefill[i % len(new_prefill)],
+                  params=primary_lane_params[new_prefill[i % len(new_prefill)]],
+                  cache=jax.device_put(
+                      init_slot_cache(self.cfg, 1, self.t_max,
+                                      dtype=self.config.cache_dtype),
+                      new_prefill[i % len(new_prefill)]),
+                  state=jax.device_put(
+                      init_slot_state(1, seed=self.config.seed),
+                      new_prefill[i % len(new_prefill)]))
+            for i in range(int(dc.n_prefill_lanes))
+        ]
+        new_cache, new_state = self._warm_layout(
+            new_params_by_version[self._weights_version], new_cache,
+            new_state, new_lanes, primary_lane_params, dsh, mesh)
+
+        # -- commit: one host-side swap, nothing half-bound ----------------
+        old_decode_dead = any(d in dead for d in self.decode_devices)
+        retired = _DrainingLayout(
+            layout_id=self._active_layout_id, cache=self._cache,
+            state=self._state, params_by_version=self._params_by_version,
+            decoding=self._decoding,
+        )
+        retried = 0
+        rebound = 0
+        self._decoding = {}
+        self._handoffs.clear()  # stale pages target the retired placement
+        if retired.decoding:
+            if old_decode_dead:
+                # The old decode placement lost a device: its KV is gone, so
+                # every in-flight decode replays from scratch (idempotent —
+                # same prompt/rng/version), spending one retry each.
+                for req in list(retired.decoding.values()):
+                    req.slot = None
+                    retried += 1
+                    self._rstats["retried_decodes"] += 1
+                    self._retry_or_fail(
+                        req, reason="decode device lost in resize")
+                retired.decoding = {}
+            else:
+                self._draining_layouts.append(retired)
+        # Mid-prefill requests re-queue at the head in their original order,
+        # WITHOUT spending a retry — a resize is not a failure. reset binds
+        # slot/lane to None; weights_version survives (every installed
+        # version was copied), so the replay is bit-equal.
+        for req in reversed(list(self._prefilling)):
+            req.reset_for_retry()
+            rebound += 1
+            self._rstats["rebound_requests"] += 1
+            self._queue.appendleft(req)
+        self._prefilling.clear()
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._used_slots = set()
+        self._quarantined_slots = set()
+        self._quarantined_lanes = set()
+        self._degraded = False
+        self._cache, self._state = new_cache, new_state
+        self._params_by_version = new_params_by_version
+        self._params = new_params_by_version[self._weights_version]
+        self._params_decode = self._params
+        self._lane_params = new_lane_params
+        self._lanes = new_lanes
+        self._free_lanes = deque(new_lanes)
+        self.slice_plan = plan
+        self.prefill_devices = new_prefill
+        self.decode_devices = new_decode
+        self._decode_mesh = mesh
+        self._decode_sharding = dsh
+        self._devices = devs
+        self._active_layout_id += 1
+        # Per-layout executables are a feature, not a recompile: re-baseline
+        # the census at the (pre-warmed) post-commit size, so "steady
+        # recompiles" keeps meaning what it meant — growth WITHIN a layout.
+        size = _cache_size(self._decode)
+        if size is not None:
+            self._decode_executables_baseline = size
+        self._rstats["resizes"] += 1
+        if _log_ok():
+            logger.info(
+                "disagg: resized %d -> %d devices (%d prefill / %d decode, "
+                "ratio %.3g, layout %d); %d request(s) rebound, %d retried, "
+                "%d draining", old_n, len(devs), plan.n_prefill,
+                plan.n_decode, ratio, self._active_layout_id, rebound,
+                retried, len(retired.decoding),
+            )
+        rec = {
+            "ok": True, "seq": seq, "layout_id": self._active_layout_id,
+            "n_devices": len(devs), "n_prefill": plan.n_prefill,
+            "n_decode": plan.n_decode, "flop_ratio": round(ratio, 6),
+            "rebound": rebound, "retried": retried,
+            "draining": len(retired.decoding),
+            "moved_bytes": int(ex_stats["bytes"]),
+        }
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_event("serving_resized", **rec)
+            except Exception:
+                pass
+        return rec
+
+    def _warm_layout(self, params, cache, state, lanes, lane_params, dsh,
+                     mesh) -> tuple:
+        """Pre-commit compile warm for a target layout: every ladder rung on
+        one lane per unique prefill device (prefill + extract), the per-rung
+        inserts and the arm on the new decode placement, then the decode
+        step itself. All on the NEW buffers — a failure here aborts the
+        resize with live state untouched; after the commit the new layout
+        serves its first real request with zero compile pauses. Safe for
+        bit-equality for the same reason construction's pre-warm is: the
+        garbage lands in inactive rows/below future inserts, and the one
+        armed slot is released before anything can observe it."""
+        prompt_len = min(sum(self.ladder), self.t_max - 2)
+        chunks = plan_chunks(prompt_len, self.ladder)
+        seen = set()
+        for lane in lanes:
+            if lane.device in seen:
+                continue
+            seen.add(lane.device)
+            start = 0
+            arm_args = None
+            for j, (size, valid) in enumerate(chunks):
+                chunk = np.zeros((1, size), np.int32)
+                lane.cache, lane.state, tok, done0 = self._prefill(
+                    lane_params[lane.device], lane.cache, lane.state, chunk,
+                    np.int32(0), np.int32(valid), np.int32(1),
+                    jax.random.key(self.config.seed),
+                    j == 0, j == len(chunks) - 1,
+                )
+                pages = self._extract(lane.cache.k, lane.cache.v,
+                                      np.int32(start), size)
+                pages_d = jax.device_put(pages, dsh)
+                cache = self._insert(cache, pages_d[0], pages_d[1],
+                                     np.int32(0), np.int32(start),
+                                     np.int32(valid))
+                start += valid
+                if j == len(chunks) - 1:
+                    arm_args = jax.device_put(
+                        (tok, done0, lane.state.rng[0]), dsh)
+            if arm_args is not None:
+                tok, done0, carry = arm_args
+                state = self._arm(state, np.int32(0), tok, done0,
+                                  np.int32(1), carry)
+                state = _release_step(state, np.int32(0))
+        for _ in range(4 if mesh is not None else 1):
+            cache, state, _, _ = self._decode(params, cache, state,
+                                              self._full_mask)
+        return cache, state
+
+    def _drain_decode_tick(self) -> None:
+        """Advance every retired layout's surviving decodes by one step —
+        the same compiled decode program, dispatched at the OLD placement
+        (its cache entry already exists, so draining never compiles).
+        Completions finish ``ok`` directly: a retired slot index must never
+        reach the ACTIVE free list."""
+        if not self._draining_layouts:
+            return
+        for L in list(self._draining_layouts):
+            versions = sorted({r.weights_version
+                               for r in L.decoding.values()})
+            for v in versions:
+                mask = np.zeros((self.n_slots,), bool)
+                for slot, r in L.decoding.items():
+                    if r.weights_version == v:
+                        mask[slot] = True
+                L.cache, L.state, tok, bad = self._decode(
+                    L.params_by_version[v], L.cache, L.state, mask)
+                self._stats["decode_steps"] += 1
+                tok_np, done_np, bad_np = jax.device_get(
+                    (tok, L.state.done, bad))
+                for slot, req in list(L.decoding.items()):
+                    if req.weights_version != v or not mask[slot]:
+                        continue
+                    if bool(bad_np[slot]):
+                        del L.decoding[slot]
+                        L.state = _release_step(L.state, np.int32(slot))
+                        req.slot = None
+                        self._retry_or_fail(
+                            req, reason=("nonfinite logits while draining "
+                                         f"layout {L.layout_id}"))
+                        continue
+                    req.out.append(int(tok_np[slot]))
+                    if bool(done_np[slot]):
+                        del L.decoding[slot]
+                        self._finish(req, "ok")
+        self._prune_drained()
+
+    def _prune_drained(self) -> None:
+        alive = [L for L in self._draining_layouts if L.decoding]
+        drained = len(self._draining_layouts) - len(alive)
+        if drained:
+            self._draining_layouts = alive
+            self._rstats["drained_layouts"] += drained
+            if _log_ok():
+                logger.info("disagg: %d retired layout(s) fully drained",
+                            drained)
+
+    def _extra_inflight(self) -> list:
+        reqs = []
+        for L in self._draining_layouts:
+            reqs.extend(L.decoding.values())
+        return reqs
+
+    def _evict(self, req, status: str) -> None:
+        """Drain-aware eviction: a request finishing on a retired layout
+        releases THAT layout's row — the base path would free the same slot
+        index in the ACTIVE layout, handing one slot to two requests."""
+        for L in self._draining_layouts:
+            if req.slot is not None and L.decoding.get(req.slot) is req:
+                del L.decoding[req.slot]
+                L.state = _release_step(L.state, np.int32(req.slot))
+                self._finish(req, status)
+                self._prune_drained()
+                return
+        super()._evict(req, status)
+
     # -- weight publication ------------------------------------------------
 
     def _install_params(self, params, version: int) -> None:
@@ -595,6 +974,8 @@ class DisaggServingEngine(ServingEngine):
         super().reset_metrics()
         for k in self._hstats:
             self._hstats[k] = 0
+        for k in self._rstats:
+            self._rstats[k] = 0.0 if k == "transfer_wall_s" else 0
         self._handoff_lat_s.clear()
 
     # -- reporting ---------------------------------------------------------
@@ -640,6 +1021,14 @@ class DisaggServingEngine(ServingEngine):
             "measured_flop_ratio": (
                 round(measured, 6) if measured is not None else None),
         }
+        rs = dict(self._rstats)
+        rs["transfer_wall_s"] = round(rs["transfer_wall_s"], 6)
+        rs["active_layout"] = self._active_layout_id
+        rs["n_devices"] = len(self._devices)
+        rs["draining_layouts"] = len(self._draining_layouts)
+        rs["draining_requests"] = sum(
+            len(L.decoding) for L in self._draining_layouts)
+        out["disagg"]["resize"] = rs
         return out
 
     def _push_telemetry_summary(self) -> None:

@@ -599,6 +599,25 @@ def scaled_layout(layout: dict, n_devices: int) -> Optional[dict]:
     return out
 
 
+def validate_world_size(n_devices: int, layout: Optional[dict] = None) -> bool:
+    """Whether ``n_devices`` is a viable world size — THE shared topology
+    gate for everything that proposes one: ``GangSupervisor
+    --shrink_after_dead_hosts`` (via :func:`resharding.shrink_world_size`)
+    and the serving autoscaler (autoscale.py, directly and via
+    ``grow_world_size``) both route through here so their notions of
+    "valid" can't drift. With a recorded ``layout`` the answer is the
+    planner's: :func:`scaled_layout` must rescale the data-parallel extent
+    to ``n_devices`` with every model-parallel axis still dividing it.
+    Without one, any positive count is viable (the pow2 preference the
+    grow/shrink helpers apply is policy, not validity)."""
+    n = int(n_devices)
+    if n < 1:
+        return False
+    if layout:
+        return scaled_layout(layout, n) is not None
+    return True
+
+
 @dataclasses.dataclass
 class ParallelPlan:
     """Versioned, deterministic plan artifact. ``to_json`` of two plans built

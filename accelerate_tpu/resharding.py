@@ -290,8 +290,8 @@ def shrink_world_size(current: int, lost: int = 1, layout: Optional[dict] = None
 
     With a recorded layout (a plan artifact's, or the run's parallelism
     config), the answer is the largest size at or below ``current - lost``
-    the planner validates via :func:`planner.scaled_layout` — i.e. the
-    model-parallel axes still divide it, so the elastic resume reshards
+    the planner validates via :func:`planner.validate_world_size` — i.e.
+    the model-parallel axes still divide it, so the elastic resume reshards
     instead of re-searching. Without one, the largest power of two at or
     below the target, which keeps dp sharding even on any checkpoint.
     Returns None when no viable smaller size exists."""
@@ -299,16 +299,43 @@ def shrink_world_size(current: int, lost: int = 1, layout: Optional[dict] = None
     if target < 1:
         return None
     if layout:
-        from .planner import scaled_layout
+        from .planner import validate_world_size
 
         for n in range(target, 0, -1):
-            if scaled_layout(layout, n) is not None:
+            if validate_world_size(n, layout):
                 return n
         return None
     n = 1
     while n * 2 <= target:
         n *= 2
     return n
+
+
+def grow_world_size(current: int, gained: int = 1,
+                    layout: Optional[dict] = None) -> Optional[int]:
+    """Symmetric inverse of :func:`shrink_world_size`, for the serving
+    autoscaler (autoscale.py): the world size to grow to after ``gained``
+    spare device(s) became available. With a recorded layout, the largest
+    planner-validated size in ``(current, current + gained]`` (same shared
+    :func:`planner.validate_world_size` gate as the shrink path); without
+    one, the largest power of two at or below the target. Returns None
+    when no viable LARGER size exists — growing sideways or down is never
+    an answer here."""
+    cur = int(current)
+    if cur < 1:
+        return None
+    target = cur + max(1, int(gained))
+    if layout:
+        from .planner import validate_world_size
+
+        for n in range(target, cur, -1):
+            if validate_world_size(n, layout):
+                return n
+        return None
+    n = 1
+    while n * 2 <= target:
+        n *= 2
+    return n if n > cur else None
 
 
 # ----------------------------------------------------------------------
@@ -671,13 +698,17 @@ class ReshardExecutor:
 
     # -- execution -----------------------------------------------------
 
-    def put_tree(self, tree, dst_shardings, prefix: str = ""):
+    def put_tree(self, tree, dst_shardings, prefix: str = "",
+                 donate: bool = True):
         """Redistribute every leaf of ``tree`` to ``dst_shardings``.
 
         Host (numpy) leaves are ingested under their source spec projected
         onto the live mesh, then redistributed on-device in budget-bounded
         batches; device (``jax.Array``) leaves are re-put directly with
-        donated buffers. Returns the resharded tree."""
+        donated buffers (pass ``donate=False`` to keep the source alive —
+        the serving autoscaler's live resize copies params to the new
+        layout while in-flight requests still decode on the old one).
+        Returns the resharded tree."""
         import jax
 
         t0 = time.monotonic()
@@ -729,7 +760,8 @@ class ReshardExecutor:
             if staged:
                 positions, arrays, dsts = zip(*staged)
                 try:
-                    moved = jax.device_put(list(arrays), list(dsts), donate=True)
+                    moved = jax.device_put(list(arrays), list(dsts),
+                                           donate=bool(donate))
                 except TypeError:  # older jax without donate kwarg
                     moved = jax.device_put(list(arrays), list(dsts))
                 for pos, arr in zip(positions, moved):

@@ -342,6 +342,29 @@ def _release_slot_op(state: SlotState, slot) -> SlotState:
 _release_step = jax.jit(_release_slot_op, donate_argnums=(0,))
 
 
+def _slo_aggregate(events) -> dict:
+    """Shared SLO arithmetic over terminal-request events (``{"status",
+    "ttft_s", "tpot_s"}`` dicts): ok-only latency samples plus per-status
+    rates. ONE implementation backs both the canary cohort gates
+    (:meth:`ServingEngine.cohort_stats`) and the rolling window the
+    autoscaler polls (:meth:`ServingEngine.window_stats`), so the two SLO
+    readings can't drift."""
+    n = len(events)
+    ok = [e for e in events if e["status"] == "ok"]
+    ttft = np.asarray([e["ttft_s"] for e in ok if e["ttft_s"] is not None],
+                      np.float64)
+    tpot = np.asarray([e["tpot_s"] for e in ok if e["tpot_s"] is not None],
+                      np.float64)
+
+    def rate(status):
+        return (sum(1 for e in events if e["status"] == status) / n
+                if n else 0.0)
+
+    return {"n": n, "ok": len(ok), "ttft": ttft, "tpot": tpot,
+            "timeout_rate": rate("timeout"), "shed_rate": rate("shed"),
+            "failed_rate": rate("failed")}
+
+
 def _cache_size(fn) -> Optional[int]:
     size_fn = getattr(fn, "_cache_size", None)
     if callable(size_fn):
@@ -361,7 +384,7 @@ class _Request:
     __slots__ = (
         "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
         "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
-        "deadline", "retries", "status", "weights_version", "canary",
+        "deadline", "retries", "status", "weights_version", "canary", "layout",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -384,6 +407,7 @@ class _Request:
         self.status = None            # terminal: ok | timeout | shed | failed
         self.weights_version = None   # param version bound at first grant
         self.canary = False           # admitted inside a canary window
+        self.layout = None            # topology generation bound at grant
 
     def reset_for_retry(self) -> None:
         """Back to freshly-queued: prompt, budget, rng, deadline, the
@@ -504,6 +528,10 @@ class ServingEngine:
         self._canary_acc = 0.0       # error-diffusion routing accumulator
         self._cohorts: dict[int, dict] = {}
         self._full_mask = np.ones((self.n_slots,), bool)
+        # Topology generation: bumped by the disagg router's live resize so
+        # in-flight requests can be told apart from post-resize admissions.
+        # The colocated engine never resizes — the id stays 0 for life.
+        self._active_layout_id = 0
 
         self._queue: deque[_Request] = deque()
         self._prefilling: deque[_Request] = deque()
@@ -521,6 +549,14 @@ class ServingEngine:
         # granted — the split that tells congestion from compute.
         self._queue_waits: list[float] = []
         self._prefill_lats: list[float] = []
+        # Rolling-window SLO aggregates (stats()["window"]): the lifetime
+        # percentiles above average over the whole run, so a long healthy
+        # prefix masks a current breach (and an early shed storm taints the
+        # rates forever). The autoscaler and canary gates read this bounded
+        # window instead.
+        wn = max(1, int(getattr(c, "window_requests", 128) or 128))
+        self._window: deque[dict] = deque(maxlen=wn)
+        self._queue_depth_window: deque[int] = deque(maxlen=wn)
         self._stats = {
             "submitted": 0, "completed": 0, "ticks": 0, "decode_steps": 0,
             "prefill_chunks": 0, "prefill_pad_tokens": 0, "tokens_out": 0,
@@ -624,8 +660,10 @@ class ServingEngine:
 
     @property
     def pending(self) -> int:
-        """Requests not yet delivered (queued + prefilling + decoding)."""
-        return len(self._queue) + len(self._prefilling) + len(self._decoding)
+        """Requests not yet delivered (queued + prefilling + decoding,
+        including any draining on a retired layout after a live resize)."""
+        return (len(self._queue) + len(self._prefilling) + len(self._decoding)
+                + len(self._extra_inflight()))
 
     # -- the tick ----------------------------------------------------------
 
@@ -638,8 +676,7 @@ class ServingEngine:
         progress."""
         snap = self._begin_tick()
         self._admit()
-        self._stats["queue_depth_sum"] += len(self._queue)
-        self._stats["queue_samples"] += 1
+        self._sample_queue_depth()
         for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
             if not self._prefilling:
                 break
@@ -649,6 +686,21 @@ class ServingEngine:
         self._end_tick(snap)
 
     # -- robustness plumbing (shared with the disagg router's tick) --------
+
+    def _sample_queue_depth(self) -> None:
+        """One queue-depth sample per tick, feeding both the lifetime mean
+        and the rolling window the autoscaler reads — shared by this tick
+        and the disagg router's."""
+        depth = len(self._queue)
+        self._stats["queue_depth_sum"] += depth
+        self._stats["queue_samples"] += 1
+        self._queue_depth_window.append(depth)
+
+    def _extra_inflight(self) -> list:
+        """Requests in flight outside the active queues — the disagg
+        router's draining layouts during a live resize. Colocated engines
+        have none."""
+        return []
 
     def _progress_marker(self) -> tuple:
         """Anything that changes when the engine moves: admissions, prefill
@@ -703,7 +755,7 @@ class ServingEngine:
     def _expire_deadlines(self) -> None:
         now = time.perf_counter()
         stale = [r for r in list(self._queue) + list(self._prefilling)
-                 + list(self._decoding.values())
+                 + list(self._decoding.values()) + self._extra_inflight()
                  if r.deadline is not None and now >= r.deadline]
         for req in stale:
             self._evict(req, "timeout")
@@ -724,6 +776,7 @@ class ServingEngine:
         """Grant ``slot`` to ``req`` and move it onto the prefill queue —
         shared by this scheduler and the disagg router's two-mesh _admit."""
         req.slot = slot
+        req.layout = self._active_layout_id
         req.admit_t = time.perf_counter()
         req.chunks = plan_chunks(int(req.tokens.size), self.ladder)
         if req.weights_version is None or \
@@ -898,6 +951,10 @@ class ServingEngine:
         else:
             self._fstats[{"timeout": "timeouts", "shed": "sheds",
                           "failed": "failed"}[status]] += 1
+        self._window.append({
+            "status": status, "ttft_s": ttft, "tpot_s": tpot,
+            "prompt_tokens": int(req.tokens.size), "new_tokens": n_new,
+        })
         if req.canary and req.weights_version in self._cohorts:
             self._cohorts[req.weights_version]["events"].append({
                 "status": status, "ttft_s": ttft, "tpot_s": tpot,
@@ -1089,7 +1146,8 @@ class ServingEngine:
         if self._canary is not None:
             keep.add(self._canary["version"])
         for r in itertools.chain(self._queue, self._prefilling,
-                                 self._decoding.values()):
+                                 self._decoding.values(),
+                                 self._extra_inflight()):
             if r.weights_version is not None:
                 keep.add(r.weights_version)
         for v in [v for v in self._params_by_version if v not in keep]:
@@ -1250,26 +1308,53 @@ class ServingEngine:
         co = self._cohorts.get(version)
         if co is None:
             return None
-        events = co["events"][int(warmup):]
-        n = len(events)
-        ok = [e for e in events if e["status"] == "ok"]
-        ttft = [e["ttft_s"] for e in ok if e["ttft_s"] is not None]
-        tpot = [e["tpot_s"] for e in ok if e["tpot_s"] is not None]
-
-        def rate(status):
-            return (sum(1 for e in events if e["status"] == status) / n
-                    if n else 0.0)
-
+        agg = _slo_aggregate(co["events"][int(warmup):])
         return {
             "version": int(version),
-            "completed": n,
-            "ok": len(ok),
-            "ok_ttft_mean_s": float(np.mean(ttft)) if ttft else None,
-            "ok_tpot_mean_s": float(np.mean(tpot)) if tpot else None,
-            "timeout_rate": rate("timeout"),
-            "shed_rate": rate("shed"),
-            "failed_rate": rate("failed"),
+            "completed": agg["n"],
+            "ok": agg["ok"],
+            "ok_ttft_mean_s": (float(agg["ttft"].mean())
+                               if agg["ttft"].size else None),
+            "ok_tpot_mean_s": (float(agg["tpot"].mean())
+                               if agg["tpot"].size else None),
+            "timeout_rate": agg["timeout_rate"],
+            "shed_rate": agg["shed_rate"],
+            "failed_rate": agg["failed_rate"],
             "poisoned": int(co["poisoned"]),
+        }
+
+    def window_stats(self) -> dict:
+        """Rolling-window SLO aggregates over the last
+        ``ServingConfig.window_requests`` terminal requests (and as many
+        per-tick queue-depth samples) — the signals the autoscaler polls.
+        TTFT/TPOT percentiles are ok-only; ``prompt_decode_ratio`` is the
+        window's observed prefill:decode work split (ok prompt tokens in
+        over ok tokens out), the number a planner consult re-splits the
+        disagg slices under."""
+        agg = _slo_aggregate(list(self._window))
+        qd = np.asarray(self._queue_depth_window, np.float64)
+        ok_prompt = sum(e["prompt_tokens"] for e in self._window
+                        if e["status"] == "ok")
+        ok_new = sum(e["new_tokens"] for e in self._window
+                     if e["status"] == "ok")
+
+        def pct(a, q):
+            return float(np.percentile(a, q)) if a.size else None
+
+        return {
+            "requests": agg["n"],
+            "capacity": self._window.maxlen,
+            "ok": agg["ok"],
+            "ttft_p50_s": pct(agg["ttft"], 50),
+            "ttft_p95_s": pct(agg["ttft"], 95),
+            "tpot_p50_s": pct(agg["tpot"], 50),
+            "tpot_p95_s": pct(agg["tpot"], 95),
+            "shed_rate": agg["shed_rate"],
+            "timeout_rate": agg["timeout_rate"],
+            "failed_rate": agg["failed_rate"],
+            "queue_depth_p95": pct(qd, 95),
+            "prompt_decode_ratio": (round(ok_prompt / ok_new, 4)
+                                    if ok_new else None),
         }
 
     # -- batch front-end ---------------------------------------------------
@@ -1336,6 +1421,8 @@ class ServingEngine:
         self._tpots.clear()
         self._queue_waits.clear()
         self._prefill_lats.clear()
+        self._window.clear()
+        self._queue_depth_window.clear()
         self._finished.clear()
 
     # -- reporting ---------------------------------------------------------
@@ -1402,6 +1489,7 @@ class ServingEngine:
             "prefill_executables": execs["prefill"],
             "weights_version": self._weights_version,
             "canary": self.canary_status(),
+            "window": self.window_stats(),
             "faults": self.fault_stats(),
         }
         return out

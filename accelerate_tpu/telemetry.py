@@ -184,6 +184,9 @@ class TelemetryRecorder:
         # Weight-publication block (publish.py): publish/promote/rollback
         # counts, redistribution bytes, swap latency.
         self._publish_summary: Optional[dict] = None
+        # Autoscale block (autoscale.py): decision/resize counters and the
+        # controller's live state (cooldown, breach streaks, device census).
+        self._autoscale_summary: Optional[dict] = None
         # Auto-parallelism plan (planner.py): note_plan installs the active
         # plan; after _plan_calibrate_after steps the measured step time +
         # peak HBM are written back into the plan artifact (the calibration
@@ -633,6 +636,18 @@ class TelemetryRecorder:
             **self._disagg_summary,
         })
 
+    def record_autoscale(self, block: dict) -> None:
+        """Autoscaling aggregate (autoscale.py ``stats()``): samples,
+        decisions split by action (holds/grows/shrinks/resplits), resize vs
+        abort counts, flap-damped decisions, and the device census. Written
+        as a JSONL record and embedded as the summary's ``autoscale`` block;
+        last push wins."""
+        self._autoscale_summary = dict(block)
+        self._write({
+            "event": "autoscale_summary", "step": self.step,
+            "time": time.time(), **self._autoscale_summary,
+        })
+
     def record_publish(self, block: dict) -> None:
         """Weight-publication aggregate (publish.py ``stats()``): scans,
         publishes, promotions/rollbacks, BandwidthTable-priced
@@ -733,6 +748,10 @@ class TelemetryRecorder:
             # Weight-publication block (publish.py): publish outcomes,
             # redistribution bytes, swap latency; rides next to "serving".
             out["publish"] = dict(self._publish_summary)
+        if self._autoscale_summary is not None:
+            # Autoscale block (autoscale.py): decisions, resizes, aborts,
+            # flap-damped holds, device census; rides next to "serving".
+            out["autoscale"] = dict(self._autoscale_summary)
         plan_block = self.plan_block()
         if plan_block is not None:
             # Auto-parallelism plan block (planner.py): predicted vs

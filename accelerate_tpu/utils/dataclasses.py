@@ -642,6 +642,11 @@ class ServingConfig(KwargsHandler):
       decode, or retirement, the engine raises
       :class:`~accelerate_tpu.serving.ServingStalledError` naming the stuck
       requests instead of spinning forever.
+    - ``window_requests``: size of the rolling SLO window behind
+      ``stats()["window"]`` (last N terminal requests + N per-tick
+      queue-depth samples). Lifetime percentiles average the whole run, so
+      a long healthy prefix masks a current breach; the autoscaler
+      (autoscale.py) and canary gates read this window instead.
     """
 
     enabled: bool = True
@@ -664,6 +669,7 @@ class ServingConfig(KwargsHandler):
     deadline_s: Optional[float] = None
     max_retries: int = 2
     max_idle_ticks: int = 100
+    window_requests: int = 128
 
     def __post_init__(self):
         if self.n_slots < 1:
@@ -690,6 +696,8 @@ class ServingConfig(KwargsHandler):
             raise ValueError("max_retries must be >= 0")
         if self.max_idle_ticks < 1:
             raise ValueError("max_idle_ticks must be >= 1")
+        if self.window_requests < 1:
+            raise ValueError("window_requests must be >= 1")
 
 
 @dataclass

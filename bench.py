@@ -377,6 +377,18 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                           "steady_recompiles", "decode_executables",
                           "faults")
             }
+        # Autoscale block (autoscale.py via telemetry.record_autoscale):
+        # decision/resize counters ride next to the faults block so an
+        # elastic round shows how often (and why) the topology moved
+        # alongside the latencies it produced.
+        if t.get("autoscale"):
+            au = t["autoscale"]
+            result["telemetry"]["autoscale"] = {
+                k: au.get(k)
+                for k in ("samples", "decisions", "holds", "grows", "shrinks",
+                          "resplits", "dead_device_shrinks", "resizes",
+                          "aborts", "flap_damped", "active_devices")
+            }
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).
     _emit(round(r2k["tok_s"], 1), unit_2k("; seq-8192 pending"),

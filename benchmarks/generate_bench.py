@@ -36,10 +36,23 @@ Three rows, one JSON line each:
   swap latency, BandwidthTable-priced redistribution bytes, the canary
   window (routed counts + decision), and the faults block, with the
   zero-recompile swap evidenced by the executable census.
+- ``--trace diurnal`` swaps the flat Poisson arrivals for the seeded
+  diurnal generator (:func:`accelerate_tpu.autoscale.make_diurnal_trace`:
+  low / 10x-high / low plateaus with a shifting prompt:decode mix) — ONE
+  request set shared by every serving row above, so static, continuous,
+  disagg, chaos, and publish are priced on identical load.
+- ``--autoscale`` (implies ``--serving`` and ``--trace diurnal``) adds a
+  ``serving_autoscale`` row: the trace through a disagg engine that starts
+  on HALF the mesh with an :class:`~accelerate_tpu.autoscale.
+  AutoscaleController` closing the loop — resize count and decision
+  counters, a per-plateau SLO block (p95 TTFT on the high vs low
+  plateaus), and the executable census proving resizes did not recompile
+  the steady state.
 
     python benchmarks/generate_bench.py [--params-b 1] [--new-tokens 64]
                                         [--serving] [--disagg] [--chaos]
-                                        [--publish] [--qps 8]
+                                        [--publish] [--autoscale]
+                                        [--trace poisson|diurnal] [--qps 8]
 """
 
 import argparse
@@ -102,12 +115,24 @@ def main():
                          "checkpoint into the live engine mid-trace through "
                          "a canary window; implies --serving)")
     ap.add_argument("--canary-fraction", type=float, default=0.25)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="add a serving_autoscale row (diurnal trace through "
+                         "a half-mesh disagg engine with an "
+                         "AutoscaleController closing the loop; implies "
+                         "--serving and --trace diurnal)")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--qps", type=float, default=8.0,
-                    help="Poisson arrival rate for the serving rows")
+                    help="Poisson arrival rate for the serving rows (the "
+                         "diurnal trace's low-plateau rate)")
+    ap.add_argument("--trace", choices=("poisson", "diurnal"),
+                    default="poisson",
+                    help="arrival process shared by every serving row")
+    ap.add_argument("--trace-seed", type=int, default=1)
     args = ap.parse_args()
-    if args.disagg or args.chaos or args.publish:
+    if args.autoscale:
+        args.trace = "diurnal"
+    if args.disagg or args.chaos or args.publish or args.autoscale:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -210,15 +235,32 @@ def main():
 
         srng = np.random.default_rng(1)
         n, slots = args.requests, args.slots
-        lengths = srng.integers(4, max(9, args.prompt_len), n)
-        budgets = np.where(
-            srng.random(n) < 0.5,
-            srng.integers(4, 12, n),
-            srng.integers(max(2, args.new_tokens // 2), args.new_tokens + 1, n),
-        ).astype(int)
-        reqs = [srng.integers(1, cfg.vocab_size, (int(L),), dtype=np.int32)
-                for L in lengths]
-        arrivals = np.cumsum(srng.exponential(1.0 / args.qps, n))
+        phases = None
+        if args.trace == "diurnal":
+            # One seeded diurnal trace shared by EVERY serving row below:
+            # low / high / low plateaus at a 10x rate swing with the
+            # prompt:decode mix shifting against it (autoscale.py).
+            from accelerate_tpu.autoscale import make_diurnal_trace
+
+            dtrace = make_diurnal_trace(n, seed=args.trace_seed,
+                                        base_rate=args.qps,
+                                        vocab_size=cfg.vocab_size)
+            reqs = dtrace["prompts"]
+            lengths = np.asarray(dtrace["lengths"])
+            budgets = np.asarray(dtrace["budgets"], dtype=int)
+            arrivals = np.asarray(dtrace["arrivals"])
+            phases = np.asarray(dtrace["phases"])
+        else:
+            lengths = srng.integers(4, max(9, args.prompt_len), n)
+            budgets = np.where(
+                srng.random(n) < 0.5,
+                srng.integers(4, 12, n),
+                srng.integers(max(2, args.new_tokens // 2),
+                              args.new_tokens + 1, n),
+            ).astype(int)
+            reqs = [srng.integers(1, cfg.vocab_size, (int(L),),
+                                  dtype=np.int32) for L in lengths]
+            arrivals = np.cumsum(srng.exponential(1.0 / args.qps, n))
         useful = int(budgets.sum())
 
         # Static gang: batches of `slots` in arrival order, left-padded to
@@ -443,6 +485,87 @@ def main():
                 "decode_executables": pst["decode_executables"],
                 "steady_recompiles": pst["steady_recompiles"],
                 "faults": pst["faults"],
+            }), flush=True)
+
+        # Autoscale row: the diurnal trace through a disagg engine that
+        # starts on HALF the mesh with an AutoscaleController closing the
+        # telemetry -> planner -> live-resize loop. The row prices
+        # elasticity next to the fixed-topology rows: resize count and
+        # decision counters, a per-plateau SLO block (p95 TTFT on the high
+        # vs low plateaus), and the executable census (a resize must not
+        # recompile the steady state).
+        if args.autoscale and len(jax.devices()) < 2:
+            print(json.dumps({
+                "row": "serving_autoscale", "skipped": "needs >= 2 devices",
+            }), flush=True)
+        elif args.autoscale:
+            from accelerate_tpu import (
+                AutoscaleConfig,
+                AutoscaleController,
+                DisaggConfig,
+                DisaggServingEngine,
+            )
+
+            pool = jax.devices()
+            start = max(2, len(pool) // 2)
+            acfg = ServingConfig(n_slots=slots, max_len=t_cap,
+                                 max_prefill_chunk=max(16, args.prompt_len),
+                                 max_retries=3,
+                                 max_idle_ticks=max(100, 4 * t_cap))
+            aengine = DisaggServingEngine(
+                res_model, acfg,
+                disagg=DisaggConfig(n_prefill_lanes=min(args.lanes, start)),
+                devices=pool[:start])
+            aengine.warmup()
+            auto = AutoscaleController(
+                aengine,
+                AutoscaleConfig(poll_ticks=8, window_min_requests=4,
+                                queue_depth_high=3.0, queue_depth_low=0.5,
+                                breach_samples=2, cooldown_ticks=40),
+                device_pool=pool)
+            ids, results = {}, {}
+            t0 = time.perf_counter()
+            nxt = 0
+            while nxt < n or aengine.pending:
+                now = time.perf_counter() - t0
+                while nxt < n and float(arrivals[nxt]) <= now:
+                    ids[nxt] = aengine.submit(reqs[nxt],
+                                              max_new_tokens=int(budgets[nxt]))
+                    nxt += 1
+                if aengine.pending:
+                    aengine.tick()
+                    auto.poll()
+                    for r in aengine.poll():
+                        results[r["id"]] = r
+            auto_s = time.perf_counter() - t0
+            ast = aengine.stats()
+            a = auto.stats()
+
+            def _plateau_p95(want_high):
+                sel = (phases == 1) if want_high else (phases != 1)
+                v = [results[ids[i]]["ttft_s"] for i in range(n)
+                     if sel[i] and i in ids
+                     and results[ids[i]]["status"] == "ok"
+                     and results[ids[i]]["ttft_s"] is not None]
+                return (round(float(np.percentile(np.asarray(v), 95)), 4)
+                        if v else None)
+
+            print(json.dumps({
+                "row": "serving_autoscale", "seconds": round(auto_s, 3),
+                "useful_tokens": ast["tokens_out"],
+                "tokens_per_s": ast["tokens_per_s"],
+                "ttft_p50_s": round(ast["ttft_p50_s"], 4),
+                "ttft_p95_s": round(ast["ttft_p95_s"], 4),
+                "slo_plateaus": {"ttft_p95_high_s": _plateau_p95(True),
+                                 "ttft_p95_low_s": _plateau_p95(False)},
+                "autoscale": {k: a[k] for k in (
+                    "samples", "decisions", "holds", "grows", "shrinks",
+                    "resplits", "dead_device_shrinks", "resizes", "aborts",
+                    "flap_damped", "active_devices", "pool_devices")},
+                "resize": ast["disagg"]["resize"],
+                "decode_executables": ast["decode_executables"],
+                "prefill_executables": ast["prefill_executables"],
+                "steady_recompiles": ast["steady_recompiles"],
             }), flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------

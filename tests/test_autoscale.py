@@ -1,0 +1,498 @@
+"""Autoscaling (autoscale.py + the serving rolling window): config
+validation, the bounded-window SLO signals (serving.py ``window_stats``),
+deterministic policy units over a fake engine (hysteresis bands,
+consecutive-breach + cooldown flap damping, planner refusals, the resize
+budget, dead-device shrinks, injected flap/spike faults), the live-resize
+integration on the real disagg engine (a mid-flight grow stays bit-equal
+to a fixed-topology reference; persistent injected ``resize_transfer``
+faults abort cleanly back to the old layout), telemetry wiring, and the
+off-unless-constructed Accelerator factory. CPU-only on the forced
+8-device host platform, tier-1."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import (
+    AutoscaleConfig,
+    AutoscaleController,
+    DisaggConfig,
+    DisaggServingEngine,
+    FaultInjector,
+    Model,
+    ServingConfig,
+    ServingEngine,
+    make_diurnal_trace,
+)
+from accelerate_tpu.planner import plan_disagg_slices
+from accelerate_tpu.utils import set_seed
+
+
+@pytest.fixture(scope="module")
+def llama():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    probe = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8),
+                                              dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+    return cfg, model
+
+
+def _prompts(cfg, lengths, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (n,), dtype=np.int32)
+            for n in lengths]
+
+
+# ---------------------------------------------------------------------------
+# A policy-level fake: the controller sees a `resize`-capable engine whose
+# window signals the test scripts directly. Mirrors the real engine's
+# re-plan-on-resize so ratio drift actually clears after a re-split.
+# ---------------------------------------------------------------------------
+
+
+class _FakePlan:
+    flop_ratio = 2.0
+    n_prefill = 2
+
+
+class _FakeEngine:
+    def __init__(self, devices):
+        self._devices = list(devices)
+        self._stats = {"ticks": 0}
+        self.slice_plan = _FakePlan()
+        self.window = dict(requests=16, capacity=32, ok=16, ttft_p50_s=0.01,
+                           ttft_p95_s=0.02, tpot_p50_s=0.001,
+                           tpot_p95_s=0.002, shed_rate=0.0, timeout_rate=0.0,
+                           failed_rate=0.0, queue_depth_p95=2.0,
+                           prompt_decode_ratio=2.0)
+        self.resize_calls = []
+        self.resize_ok = True
+
+    def window_stats(self):
+        return dict(self.window)
+
+    def resize(self, devices=None, *, n_prefill=None, flop_ratio=None,
+               dead_devices=()):
+        self.resize_calls.append((list(devices), flop_ratio,
+                                  set(dead_devices)))
+        if not self.resize_ok:
+            return {"ok": False, "reason": "injected abort",
+                    "seq": len(self.resize_calls)}
+        self._devices = list(devices)
+        if flop_ratio is not None:
+            plan = plan_disagg_slices(len(self._devices),
+                                      prefill_decode_flop_ratio=flop_ratio)
+            p = _FakePlan()
+            p.flop_ratio, p.n_prefill = plan.flop_ratio, plan.n_prefill
+            self.slice_plan = p
+        return {"ok": True, "seq": len(self.resize_calls),
+                "layout_id": len(self.resize_calls),
+                "n_devices": len(self._devices), "n_prefill": 1,
+                "n_decode": len(self._devices) - 1, "flop_ratio": flop_ratio,
+                "rebound": 0, "retried": 0, "draining": 0, "moved_bytes": 0}
+
+
+_POOL = [f"dev{i}" for i in range(8)]
+
+
+def _controller(n_start=4, pool=None, chaos=None, **over):
+    kw = dict(poll_ticks=4, window_min_requests=8, breach_samples=2,
+              cooldown_ticks=16, queue_depth_high=4.0, queue_depth_low=0.5)
+    kw.update(over)
+    eng = _FakeEngine((pool or _POOL)[:n_start])
+    auto = AutoscaleController(eng, AutoscaleConfig(**kw),
+                               device_pool=pool or _POOL, chaos=chaos)
+    return eng, auto
+
+
+def _run(eng, auto, ticks):
+    for _ in range(ticks):
+        eng._stats["ticks"] += 1
+        auto.poll()
+
+
+# ---------------------------------------------------------------------------
+# Config + trace (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_config_validation():
+    AutoscaleConfig()  # defaults are valid
+    for bad in [dict(poll_ticks=0), dict(window_min_requests=0),
+                dict(queue_depth_low=5.0, queue_depth_high=4.0),
+                dict(queue_depth_low=-1.0), dict(shed_rate_high=-0.1),
+                dict(breach_samples=0), dict(cooldown_ticks=-1),
+                dict(resplit_tolerance=0.0), dict(min_devices=1),
+                dict(max_devices=1), dict(max_resizes=-1),
+                dict(ttft_p95_slo_s=0.0)]:
+        with pytest.raises(ValueError):
+            AutoscaleConfig(**bad)
+
+
+def test_autoscale_requires_resizable_engine():
+    class NoResize:
+        _devices = _POOL[:2]
+
+    with pytest.raises(ValueError, match="resize"):
+        AutoscaleController(NoResize())
+    # The pool must cover the active set.
+    with pytest.raises(ValueError, match="pool"):
+        AutoscaleController(_FakeEngine(_POOL[:4]), device_pool=_POOL[4:])
+
+
+def test_make_diurnal_trace_deterministic_and_diurnal():
+    t1 = make_diurnal_trace(64, seed=5)
+    t2 = make_diurnal_trace(64, seed=5)
+    assert np.array_equal(t1["arrivals"], t2["arrivals"])
+    assert all(np.array_equal(a, b)
+               for a, b in zip(t1["prompts"], t2["prompts"]))
+    assert t1["budgets"] == t2["budgets"]
+    assert not np.array_equal(t1["arrivals"],
+                              make_diurnal_trace(64, seed=6)["arrivals"])
+    ph = np.asarray(t1["phases"])
+    assert set(ph.tolist()) == {0, 1, 2}
+    # The high plateau arrives ~10x faster and sends longer prompts with
+    # smaller budgets (the prompt:decode mix shifts with the load).
+    gaps = np.diff(t1["arrivals"])
+    assert np.mean(gaps[ph[1:] == 1]) < np.mean(gaps[ph[1:] == 0])
+    mean_len = lambda f: np.mean(  # noqa: E731
+        [len(p) for p, q in zip(t1["prompts"], ph) if q == f])
+    assert mean_len(1) > mean_len(0)
+    with pytest.raises(ValueError):
+        make_diurnal_trace(2)
+
+
+# ---------------------------------------------------------------------------
+# Policy units (fake engine — no device work)
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_breach_damping_and_cooldown():
+    eng, auto = _controller()
+    _run(eng, auto, 8)  # two in-band samples
+    assert auto._stats["samples"] == 2 and auto._stats["holds"] == 2
+    # Overload: one breached sample is damped, the second acts.
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 8)
+    assert auto._stats["grows"] == 1 and len(eng._devices) == 8
+    grow = next(h for h in auto.history if h["action"] == "grow")
+    assert grow["signal"] == "queue_depth_p95"
+    assert any("1/2 consecutive" in h["reason"] for h in auto.history)
+    # Cooldown: the breach persists but nothing moves inside the window.
+    _run(eng, auto, 8)
+    assert auto._stats["resizes"] == 1
+    assert any("cooldown" in h["reason"] for h in auto.history)
+    # Idle after cooldown: two under-band samples shrink.
+    eng.window["queue_depth_p95"] = 0.0
+    _run(eng, auto, 40)
+    assert auto._stats["shrinks"] >= 1 and len(eng._devices) < 8
+    # Every decision is a history record naming the triggering signal.
+    assert all(h["signal"] and h["reason"] for h in auto.history)
+    assert auto._stats["decisions"] == len(auto.history)
+
+
+def test_shed_rate_is_an_overload_signal():
+    eng, auto = _controller()
+    eng.window["shed_rate"] = 0.2
+    _run(eng, auto, 8)
+    grow = next(h for h in auto.history if h["action"] == "grow")
+    assert grow["signal"] == "shed_rate"
+
+
+def test_thin_window_holds_and_resets_breach():
+    eng, auto = _controller()
+    eng.window["requests"] = 2  # below window_min_requests=8
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 16)
+    assert auto._stats["resizes"] == 0
+    assert all(h["signal"] == "window_thin" for h in auto.history)
+
+
+def test_min_devices_and_no_spares_hold():
+    eng, auto = _controller(n_start=2, pool=_POOL[:2])
+    eng.window["queue_depth_p95"] = 0.0
+    _run(eng, auto, 16)
+    assert auto._stats["resizes"] == 0
+    assert any("min_devices" in h["reason"] for h in auto.history)
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 16)
+    assert auto._stats["resizes"] == 0
+    assert any("no spare devices" in h["reason"] for h in auto.history)
+
+
+def test_resize_budget_and_planner_refusal():
+    eng, auto = _controller(max_resizes=0)
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 16)
+    assert auto._stats["resizes"] == 0
+    assert any("budget" in h["reason"] for h in auto.history)
+    # A layout whose fixed axes validate no larger size refuses the grow
+    # through the shared planner gate.
+    eng2, auto2 = _controller(n_start=4, pool=_POOL[:7],
+                              layout={"tp": 4, "dp_shard": 1})
+    eng2.window["queue_depth_p95"] = 9.0
+    _run(eng2, auto2, 16)
+    assert auto2._stats["resizes"] == 0
+    assert auto2._stats["planner_refusals"] >= 1
+
+
+def test_flap_fault_is_damped():
+    chaos = FaultInjector(seed=11, schedule=[
+        {"point": "autoscale_decide", "kind": "flap", "tick": 4}])
+    eng, auto = _controller(chaos=chaos)
+    _run(eng, auto, 16)
+    assert auto._stats["flap_damped"] >= 1
+    assert auto._stats["resizes"] == 0
+    flap = next(h for h in auto.history if h["flap_injected"])
+    assert flap["signal"].startswith("flap(")
+
+
+def test_spike_fault_drives_real_grow_path():
+    chaos = FaultInjector(seed=11, schedule=[
+        {"point": "load_spike", "kind": "spike", "tick": 4},
+        {"point": "load_spike", "kind": "spike", "tick": 8}])
+    eng, auto = _controller(chaos=chaos)
+    _run(eng, auto, 12)
+    assert auto._stats["spikes"] == 2
+    assert auto._stats["grows"] == 1 and len(eng._devices) == 8
+
+
+def test_resplit_on_ratio_drift():
+    eng, auto = _controller(n_start=8, cooldown_ticks=4,
+                            resplit_tolerance=0.5)
+    eng.window["prompt_decode_ratio"] = 6.0  # plan says 2.0 -> 3x drift
+    _run(eng, auto, 8)
+    assert auto._stats["resplits"] == 1
+    # The engine re-planned under the observed ratio, so the drift cleared
+    # and the controller settles back to holds.
+    _run(eng, auto, 16)
+    assert auto._stats["resplits"] == 1
+    resplit = next(h for h in auto.history if h["action"] == "resplit")
+    assert resplit["signal"] == "prompt_decode_ratio"
+
+
+def test_mark_device_dead_shrinks_immediately():
+    eng, auto = _controller()
+    rec = auto.mark_device_dead(_POOL[1])
+    assert rec["action"] == "shrink" and rec["signal"] == "dead_device"
+    assert auto._stats["dead_device_shrinks"] == 1
+    assert _POOL[1] not in eng._devices and len(eng._devices) == 3
+    # A dead spare only gets recorded.
+    rec = auto.mark_device_dead(_POOL[7])
+    assert rec["action"] == "hold" and "spare" in rec["reason"]
+    assert auto._stats["dead_device_shrinks"] == 1
+    # Dead devices never re-enter later targets.
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 64)
+    for devices, _, _ in eng.resize_calls:
+        assert _POOL[1] not in devices and _POOL[7] not in devices
+
+
+def test_aborted_resize_counts_and_holds_layout():
+    eng, auto = _controller()
+    eng.resize_ok = False
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 8)
+    assert auto._stats["aborts"] == 1 and auto._stats["resizes"] == 0
+    assert len(eng._devices) == 4  # nothing half-bound
+    assert any(h["action"] == "grow_aborted" for h in auto.history)
+
+
+def test_stats_shape_and_telemetry_events():
+    class Rec:
+        events, blocks = [], []
+
+        def record_event(self, event, **fields):
+            self.events.append((event, fields))
+
+        def record_autoscale(self, block):
+            self.blocks.append(block)
+
+    rec = Rec()
+    eng = _FakeEngine(_POOL[:4])
+    auto = AutoscaleController(eng, AutoscaleConfig(poll_ticks=4),
+                               device_pool=_POOL, telemetry=rec)
+    eng.window["queue_depth_p95"] = 9.0
+    _run(eng, auto, 12)
+    s = auto.stats()
+    for k in ("samples", "decisions", "holds", "grows", "shrinks",
+              "resplits", "resizes", "aborts", "flap_damped", "spikes",
+              "planner_refusals", "active_devices", "pool_devices",
+              "dead_devices", "cooldown_until_tick", "last_action"):
+        assert k in s, k
+    assert s["pool_devices"] == 8
+    # EVERY decision (holds included) went out as an explainable event.
+    assert len(rec.events) == s["decisions"]
+    assert all(e == "autoscale_decision" and f["signal"] and f["reason"]
+               for e, f in rec.events)
+    auto.close()
+    assert rec.blocks and rec.blocks[-1]["decisions"] == s["decisions"]
+
+
+def test_telemetry_recorder_autoscale_block(tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.telemetry import TelemetryRecorder
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    rec = TelemetryRecorder(
+        acc, TelemetryKwargs(log_every=0, straggler_probe_every=0))
+    block = {"samples": 3, "decisions": 3, "resizes": 1, "grows": 1}
+    rec.record_autoscale(block)
+    assert rec.summary()["autoscale"] == block
+
+
+# ---------------------------------------------------------------------------
+# Live resize on the real engine
+# ---------------------------------------------------------------------------
+
+
+def test_live_resize_grow_and_dead_device_bit_equal(llama):
+    """The tentpole end to end: a mid-flight grow from half the mesh to all
+    of it, then a dead-decode-device shrink through the controller — every
+    request ok, every row bit-equal to a fixed 8-device reference, zero
+    steady-state recompiles across three layouts."""
+    cfg, model = llama
+    devs = jax.devices()
+    sc = ServingConfig(n_slots=8, max_len=64, prefill_chunks=[16],
+                       temperature=0.0, seed=0, max_retries=3,
+                       max_idle_ticks=200)
+    prompts = _prompts(cfg, (12, 30, 20, 26, 17, 9))
+
+    ref = DisaggServingEngine(model, sc, disagg=DisaggConfig(n_prefill_lanes=2),
+                              devices=devs)
+    ref.warmup()
+    ref_rows = ref.run(prompts, max_new_tokens=6)
+    ref.close()
+
+    eng = DisaggServingEngine(model, sc, disagg=DisaggConfig(n_prefill_lanes=2),
+                              devices=devs[:4])
+    eng.warmup()
+    auto = AutoscaleController(
+        eng, AutoscaleConfig(poll_ticks=4, cooldown_ticks=8),
+        device_pool=devs)
+    ids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    rows, tick = {}, 0
+    resized = False
+    while eng.pending:
+        eng.tick()
+        tick += 1
+        if tick == 3 and not resized:
+            rec = eng.resize(devices=devs)
+            assert rec["ok"] and rec["n_devices"] == 8
+            resized = True
+        for r in eng.poll():
+            rows[r["id"]] = r
+        assert tick < 3000
+    assert [rows[i]["status"] for i in ids] == ["ok"] * len(ids)
+    for j, i in enumerate(ids):
+        np.testing.assert_array_equal(rows[i]["tokens"], ref_rows[j])
+    st = eng.stats()
+    assert st["steady_recompiles"] == 0
+    assert st["disagg"]["resize"]["resizes"] == 1
+    assert st["disagg"]["resize"]["draining_requests"] == 0
+
+    # Controller-driven dead-device shrink: correctness path, no cooldown.
+    dead = eng.decode_devices[0]
+    rec = auto.mark_device_dead(dead)
+    assert rec["action"] == "shrink" and rec["resize"]["ok"]
+    assert dead not in eng._devices and len(eng._devices) == 7
+    rows2 = eng.run(prompts[:2], max_new_tokens=6)
+    for j in range(2):
+        np.testing.assert_array_equal(rows2[j], ref_rows[j])
+    assert eng.stats()["steady_recompiles"] == 0
+    eng.close()
+
+
+def test_resize_transfer_fault_aborts_cleanly(llama):
+    """Persistent injected resize_transfer faults: the resize aborts back
+    to the old layout with nothing half-bound, and the engine keeps
+    serving on it bit-equal."""
+    cfg, model = llama
+    devs = jax.devices()
+    sc = ServingConfig(n_slots=4, max_len=64, prefill_chunks=[16],
+                       temperature=0.0, seed=0, max_retries=3,
+                       max_idle_ticks=200)
+    # handoff_retries=0 => a drawn fault on the single attempt is terminal.
+    eng = DisaggServingEngine(
+        model, sc, disagg=DisaggConfig(n_prefill_lanes=2, handoff_retries=0),
+        devices=devs[:4])
+    eng.warmup()
+    baseline = eng.run(_prompts(cfg, (10, 14)), max_new_tokens=5)
+    eng.chaos = FaultInjector(
+        seed=3, rates={"resize_transfer": {"transfer_error": 1.0}})
+    rec = eng.resize(devices=devs)
+    assert rec["ok"] is False and "resize_transfer" in rec["reason"]
+    assert len(eng._devices) == 4  # old layout intact
+    st = eng.stats()["disagg"]["resize"]
+    assert st["resize_aborts"] == 1 and st["resizes"] == 0
+    eng.chaos = None
+    again = eng.run(_prompts(cfg, (10, 14)), max_new_tokens=5)
+    for a, b in zip(baseline, again):
+        np.testing.assert_array_equal(a, b)
+    assert eng.stats()["steady_recompiles"] == 0
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Rolling window (serving.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_window_stats_rolling_and_bounded(llama):
+    cfg, model = llama
+    with pytest.raises(ValueError):
+        ServingConfig(window_requests=0)
+    sc = ServingConfig(n_slots=4, max_len=48, prefill_chunks=[16],
+                       temperature=0.0, seed=0, window_requests=4)
+    eng = ServingEngine(model, sc)
+    prompts = _prompts(cfg, (8, 12, 10, 9, 14, 11))
+    eng.run(prompts, max_new_tokens=4)
+    w = eng.window_stats()
+    for k in ("requests", "capacity", "ok", "ttft_p50_s", "ttft_p95_s",
+              "tpot_p50_s", "tpot_p95_s", "shed_rate", "timeout_rate",
+              "failed_rate", "queue_depth_p95", "prompt_decode_ratio"):
+        assert k in w, k
+    # The window is BOUNDED: 6 completions through a 4-deep window.
+    assert w["capacity"] == 4 and w["requests"] == 4
+    assert eng.stats()["requests_completed"] == 6  # lifetime is not
+    assert w["ok"] == 4 and w["shed_rate"] == 0.0
+    assert w["ttft_p95_s"] >= w["ttft_p50_s"] >= 0.0
+    # Ratio of the windowed ok rows: 4 prompts of 8..14 tokens / 4 new each.
+    assert 8 / 4 <= w["prompt_decode_ratio"] <= 14 / 4
+    assert w["queue_depth_p95"] >= 0.0
+    assert eng.stats()["window"] == w  # embedded block matches the method
+    eng.reset_metrics()
+    assert eng.window_stats()["requests"] == 0
+    eng.close()
+
+
+def test_build_autoscale_controller_wiring(llama, tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    eng = _FakeEngine(_POOL[:4])
+    eng.chaos = FaultInjector(seed=1)
+    auto = acc.build_autoscale_controller(eng, AutoscaleConfig(poll_ticks=4),
+                                          device_pool=_POOL)
+    assert isinstance(auto, AutoscaleController)
+    assert auto.chaos is eng.chaos  # defaults to the engine's injector
+    assert auto.telemetry is acc.telemetry
+    # Off unless constructed: the colocated engine has no resize actuator.
+    cfg, model = llama
+    serving = ServingEngine(model, ServingConfig(n_slots=2, max_len=32))
+    with pytest.raises(ValueError):
+        acc.build_autoscale_controller(serving)
+    serving.close()

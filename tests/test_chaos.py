@@ -132,6 +132,8 @@ def test_injector_validation():
         "host_heartbeat",
         # weight publication
         "publish_manifest", "publish_transfer", "canary_window",
+        # autoscaling
+        "autoscale_decide", "resize_transfer", "load_spike",
     }
 
 

@@ -385,6 +385,61 @@ def test_shrink_world_size():
     assert shrink_world_size(8, lost=7) == 1
     assert shrink_world_size(8, layout={"tp": 4, "dp_shard": 2}) == 4
     assert shrink_world_size(4, lost=1, layout={"tp": 4}) is None
+    # Edge cases: losing everything (or more) leaves nothing to shrink to,
+    # and a layout whose fixed axes validate NO smaller size refuses.
+    assert shrink_world_size(8, lost=8) is None
+    assert shrink_world_size(8, lost=20) is None
+    assert shrink_world_size(0) is None
+    assert shrink_world_size(3, layout={"tp": 4, "dp_shard": 2}) is None
+    assert shrink_world_size(2, lost=1) == 1  # shrink-to-1 is legal bare...
+    assert shrink_world_size(2, lost=1, layout={"tp": 2}) is None  # ...not under tp=2
+
+
+def test_grow_world_size():
+    """The shrink helper's inverse (autoscale.py scale-up): largest viable
+    size in (current, current+gained], never sideways or down."""
+    from accelerate_tpu.resharding import grow_world_size
+
+    assert grow_world_size(4, gained=4) == 8
+    assert grow_world_size(4, gained=3) is None  # 7,6,5 hold no pow2 > 4
+    assert grow_world_size(4, gained=12) == 16
+    assert grow_world_size(1, gained=1) == 2
+    assert grow_world_size(0) is None
+    # A planner layout admits non-pow2 targets its fixed axes divide.
+    assert grow_world_size(4, gained=2, layout={"tp": 2}) == 6
+    assert grow_world_size(4, gained=2, layout={"tp": 4, "dp_shard": 2}) is None
+    # dp_shard is the rescalable axis: 12 = tp4 x dp_shard3 is viable.
+    assert grow_world_size(8, gained=4, layout={"tp": 4, "dp_shard": 2}) == 12
+    assert grow_world_size(8, gained=8, layout={"tp": 4, "dp_shard": 2}) == 16
+
+
+def test_world_size_validation_shared_helper(monkeypatch):
+    """Both shrink_world_size (the GangSupervisor's dead-host path) and
+    grow_world_size (the autoscaler's scale-up) route layout validation
+    through planner.validate_world_size — ONE topology gate, pinned so the
+    two callers can't drift apart."""
+    from accelerate_tpu import planner, resharding
+
+    assert planner.validate_world_size(8) is True
+    assert planner.validate_world_size(0) is False
+    assert planner.validate_world_size(6, {"tp": 2}) is True
+    assert planner.validate_world_size(6, {"tp": 4}) is False
+
+    seen = []
+    real = planner.validate_world_size
+
+    def spy(n, layout=None):
+        seen.append(n)
+        return real(n, layout)
+
+    monkeypatch.setattr(planner, "validate_world_size", spy)
+    resharding.shrink_world_size(8, layout={"tp": 2})
+    assert seen, "shrink_world_size bypassed the shared planner gate"
+    shrink_calls = list(seen)
+    seen.clear()
+    resharding.grow_world_size(4, gained=2, layout={"tp": 2})
+    assert seen, "grow_world_size bypassed the shared planner gate"
+    assert max(seen) <= 6 and max(shrink_calls) <= 7
 
 
 def test_launched_dead_host_chaos_supervisor(tmp_path):
