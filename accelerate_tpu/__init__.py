@@ -91,6 +91,7 @@ from .autoscale import (  # noqa: E402
     make_diurnal_trace,
 )
 from .chaos import Fault, FaultInjector, InjectedFaultError  # noqa: E402
+from .tracing import TraceConfig, TraceRecorder  # noqa: E402
 from .utils.dataclasses import (  # noqa: E402
     AutoPlanKwargs,
     DisaggConfig,

@@ -1690,7 +1690,7 @@ class Accelerator:
 
     def build_serving_engine(self, model, config: Optional[ServingConfig] = None,
                              disagg: Optional[DisaggConfig] = None, *,
-                             chaos=None):
+                             chaos=None, tracing=None):
         """Construct a :class:`~accelerate_tpu.serving.ServingEngine` over
         ``model`` (a prepared/loaded model with params on device), wired to
         this Accelerator's compile manager (prefill-chunk ladder, generation
@@ -1710,7 +1710,10 @@ class Accelerator:
         too: a SIGTERM mid-serving triggers the engine's preemption drain
         (finish in-flight, shed the queue, report exit code 75).
         ``chaos`` takes a :class:`~accelerate_tpu.chaos.FaultInjector` for
-        deterministic fault-injection runs."""
+        deterministic fault-injection runs. ``tracing`` takes a
+        :class:`~accelerate_tpu.tracing.TraceRecorder`; it defaults to the
+        recorder built from ``TelemetryKwargs(tracing=...)``, so most runs
+        only set the kwarg and the engine picks it up through telemetry."""
         cfg = config if config is not None else self.serving_config
         if cfg is None or not cfg.enabled:
             raise ValueError(
@@ -1725,6 +1728,7 @@ class Accelerator:
                 model, cfg, disagg=dcfg,
                 compile_manager=self.compile_manager, telemetry=self.telemetry,
                 fault_tolerance=self.fault_tolerance, chaos=chaos,
+                tracing=tracing,
             )
         from .serving import ServingEngine
 
@@ -1732,6 +1736,7 @@ class Accelerator:
             model, cfg,
             compile_manager=self.compile_manager, telemetry=self.telemetry,
             fault_tolerance=self.fault_tolerance, chaos=chaos,
+            tracing=tracing,
         )
 
     def build_weight_publisher(self, engine, config=None, *, chaos=None):

@@ -162,7 +162,7 @@ class AutoscaleController:
     engine never resizes itself."""
 
     def __init__(self, engine, config: Optional[AutoscaleConfig] = None, *,
-                 device_pool=None, chaos=None, telemetry=None):
+                 device_pool=None, chaos=None, telemetry=None, tracing=None):
         if not hasattr(engine, "resize"):
             raise ValueError(
                 "AutoscaleController needs an engine with a live resize "
@@ -173,6 +173,15 @@ class AutoscaleController:
         self.config = config if config is not None else AutoscaleConfig()
         self.chaos = chaos
         self.telemetry = telemetry
+        # Share the engine/telemetry trace recorder so autoscale decisions
+        # appear on the same timeline as the resize spans they trigger.
+        self.tracing = tracing
+        if self.tracing is None:
+            self.tracing = getattr(telemetry, "tracing", None)
+        if self.tracing is None:
+            self.tracing = getattr(engine, "tracing", None)
+        if self.tracing is not None:
+            self.tracing.register_gauges("autoscale", self.stats)
         pool = (list(device_pool) if device_pool is not None
                 else list(engine._devices))
         for d in engine._devices:
@@ -494,6 +503,10 @@ class AutoscaleController:
         if resize is not None:
             rec["resize"] = dict(resize)
         self.history.append(rec)
+        if self.tracing is not None and action != "hold":
+            self.tracing.instant(
+                "autoscale", f"autoscale_{action}", tick, signal=signal,
+                reason=reason, active_devices=rec["active_devices"])
         if _log_ok() and action != "hold":
             logger.info("autoscale: tick %d %s (%s — %s)", tick, action,
                         signal, reason)

@@ -115,8 +115,11 @@ Usage::
 
 from __future__ import annotations
 
+import logging
 import zlib
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "Fault",
@@ -332,6 +335,10 @@ class FaultInjector:
             } or None
             self._schedule.append(e)
         self.injected: list[dict] = []
+        # Optional annotation callback (tracing.py attaches here): called
+        # with each injected fault's log record so the trace can mark the
+        # span the fault hit. Never allowed to break an injection site.
+        self.on_inject: Optional[Callable[[dict], None]] = None
 
     # -- the draw ----------------------------------------------------------
 
@@ -363,10 +370,16 @@ class FaultInjector:
         return None
 
     def _log(self, fault: Fault) -> Fault:
-        self.injected.append({
+        rec = {
             "tick": fault.tick, "point": fault.point, "kind": fault.kind,
             "unit": fault.unit,
-        })
+        }
+        self.injected.append(rec)
+        if self.on_inject is not None:
+            try:
+                self.on_inject(rec)
+            except Exception:
+                logger.exception("chaos on_inject callback failed")
         return fault
 
     # -- reporting ---------------------------------------------------------

@@ -116,6 +116,10 @@ class _Handoff:
     ready_tick: int = 0   # straggler model: background drains wait for this
                           # tick; forced drains (depth overflow, final flush)
                           # await the transfer and proceed
+    rid: int = -1                 # owning request (trace span tree key)
+    trace_flow: Optional[int] = None  # Chrome-trace flow id: stitches this
+                                      # page's lane-side dispatch to its
+                                      # decode-slot insert
 
 
 @dataclass
@@ -131,6 +135,7 @@ class _DrainingLayout:
     state: SlotState
     params_by_version: dict
     decoding: dict          # slot -> request, frozen membership, drains down
+    trace_span: Optional[int] = None  # open "drain" span handle (tracing.py)
 
 
 class DisaggServingEngine(ServingEngine):
@@ -148,7 +153,7 @@ class DisaggServingEngine(ServingEngine):
 
     def __init__(self, model, config=None, *, disagg=None, devices=None,
                  forward_cached=None, compile_manager=None, telemetry=None,
-                 fault_tolerance=None, chaos=None):
+                 fault_tolerance=None, chaos=None, tracing=None):
         from .utils.dataclasses import DisaggConfig
 
         self.disagg_config = disagg if disagg is not None else DisaggConfig()
@@ -162,7 +167,8 @@ class DisaggServingEngine(ServingEngine):
             )
         super().__init__(model, config, forward_cached=forward_cached,
                          compile_manager=compile_manager, telemetry=telemetry,
-                         fault_tolerance=fault_tolerance, chaos=chaos)
+                         fault_tolerance=fault_tolerance, chaos=chaos,
+                         tracing=tracing)
         dc = self.disagg_config
         # Degradation state: quarantined lanes leave the pool for good; once
         # EVERY lane is gone the engine latches degraded and prefills
@@ -421,6 +427,8 @@ class DisaggServingEngine(ServingEngine):
             # the clock starts at transfer dispatch, not at lane compute.
             jax.block_until_ready(pages)
             t0 = time.perf_counter()
+        tr = self.tracing
+        th0 = time.perf_counter() if tr is not None else None
         pages_d, delay_ticks = self._handoff_put(req, lane, pages)
         nbytes = int(pages[0].nbytes + pages[1].nbytes)
         self._hstats["bytes"] += nbytes
@@ -436,13 +444,24 @@ class DisaggServingEngine(ServingEngine):
         self._handoffs.append(_Handoff(
             slot=req.slot, start=start, valid=int(valid), pages=pages_d,
             nbytes=nbytes, arm=arm, budget=int(req.budget), t0=t0,
-            ready_tick=self._stats["ticks"] + delay_ticks,
+            ready_tick=self._stats["ticks"] + delay_ticks, rid=req.id,
         ))
+        if tr is not None:
+            # Flow id stitches this page's lane-side span to the decode-slot
+            # insert in the Chrome export; set before any forced drain below
+            # can pop the handoff back off.
+            self._handoffs[-1].trace_flow = tr.handoff(
+                req.id, self._stats["ticks"], th0, time.perf_counter(),
+                lane=lane.index, slot=req.slot, nbytes=nbytes, final=is_final)
         if is_final:
             # Flush before decode can observe the slot, and release the
             # lane — its buffers are donated to the next occupant's first
             # chunk (XLA keeps pending readers safe).
+            tf0 = time.perf_counter() if tr is not None else None
             self._drain_handoffs(drain_all=True)
+            if tr is not None:
+                tr.handoff_flush(req.id, self._stats["ticks"], tf0,
+                                 time.perf_counter())
             self._hstats["flushes"] += 1
             self._free_lanes.append(lane)
             req.lane = None
@@ -492,7 +511,17 @@ class DisaggServingEngine(ServingEngine):
                     self.chaos.seed if self.chaos is not None else 0,
                     self._stats["ticks"], attempt,
                 )
-                if backoff > 0:
+                if self.tracing is not None:
+                    tb0 = time.perf_counter()
+                    if backoff > 0:
+                        time.sleep(backoff)
+                    # The measured sleep wall (not the computed value) feeds
+                    # explain()'s backoff term so it telescopes exactly.
+                    self.tracing.handoff_retry(
+                        req.id, self._stats["ticks"], attempt=attempt,
+                        backoff_s=backoff, lane=lane.index,
+                        measured_s=time.perf_counter() - tb0)
+                elif backoff > 0:
                     time.sleep(backoff)
         if poison and jnp.issubdtype(pages[0].dtype, jnp.floating):
             # Poisoned page: what lands on the decode mesh is all-NaN. The
@@ -547,6 +576,9 @@ class DisaggServingEngine(ServingEngine):
             return
         self._quarantined_lanes.add(lane.index)
         self._fstats["lane_quarantines"] += 1
+        if self.tracing is not None:
+            self.tracing.quarantine("lane", lane.index, self._stats["ticks"],
+                                    reason=reason)
         try:
             self._free_lanes.remove(lane)
         except ValueError:
@@ -591,6 +623,11 @@ class DisaggServingEngine(ServingEngine):
         if h.t0 is not None:
             jax.block_until_ready(k_page)
             self._handoff_lat_s.append(time.perf_counter() - h.t0)
+        if self.tracing is not None:
+            self.tracing.handoff_insert(
+                self._stats["ticks"], slot=h.slot, flow=h.trace_flow,
+                request_id=(h.rid if h.rid >= 0 else None),
+                armed=h.arm is not None)
 
     # -- live resize (the autoscale.py actuator) ---------------------------
 
@@ -619,9 +656,19 @@ class DisaggServingEngine(ServingEngine):
         seq = self._resize_seq
         self._resize_seq += 1
         old_n = len(self._devices)
+        tr = self.tracing
+        h_resize = (tr.begin("resize", f"resize[{seq}]", self._stats["ticks"],
+                             seq=seq, old_devices=old_n,
+                             new_devices=len(devs))
+                    if tr is not None else None)
 
         def abort(reason: str) -> dict:
             self._rstats["resize_aborts"] += 1
+            if tr is not None:
+                # Ending the outer span force-closes whichever phase span
+                # (plan/build) was open when the failure hit.
+                tr.end(h_resize, self._stats["ticks"], ok=False,
+                       reason=reason)
             if _log_ok():
                 logger.warning(
                     "disagg: resize %d -> %d devices ABORTED (%s) — old "
@@ -639,6 +686,8 @@ class DisaggServingEngine(ServingEngine):
             return rec
 
         # -- validate + plan (nothing live touched yet) --------------------
+        h_plan = (tr.begin("resize", "plan", self._stats["ticks"])
+                  if tr is not None else None)
         if any(d in dead for d in devs):
             return abort("target includes a dead device")
         if len(devs) < 2:
@@ -658,6 +707,10 @@ class DisaggServingEngine(ServingEngine):
         new_prefill = devs[:plan.n_prefill]
         new_decode = devs[plan.n_prefill:]
         mesh, cache_s, vec_s, dsh = self._decode_placement(new_decode)
+        if tr is not None:
+            tr.end(h_plan, self._stats["ticks"], n_prefill=plan.n_prefill,
+                   n_decode=plan.n_decode)
+            h_build = tr.begin("resize", "build", self._stats["ticks"])
 
         # -- param redistribution across the topology gap ------------------
         # The reshard executor prices and batches the copies; donate=False
@@ -738,6 +791,10 @@ class DisaggServingEngine(ServingEngine):
         new_cache, new_state = self._warm_layout(
             new_params_by_version[self._weights_version], new_cache,
             new_state, new_lanes, primary_lane_params, dsh, mesh)
+        if tr is not None:
+            tr.end(h_build, self._stats["ticks"],
+                   moved_bytes=int(ex_stats["bytes"]))
+            h_commit = tr.begin("resize", "commit", self._stats["ticks"])
 
         # -- commit: one host-side swap, nothing half-bound ----------------
         old_decode_dead = any(d in dead for d in self.decode_devices)
@@ -764,6 +821,13 @@ class DisaggServingEngine(ServingEngine):
                 retired.decoding = {}
             else:
                 self._draining_layouts.append(retired)
+                if tr is not None:
+                    # Detached: the drain outlives this method, ending in
+                    # _prune_drained whenever the last decode finishes.
+                    retired.trace_span = tr.begin(
+                        "resize", f"drain[layout {retired.layout_id}]",
+                        self._stats["ticks"], detached=True,
+                        draining=len(retired.decoding))
         # Mid-prefill requests re-queue at the head in their original order,
         # WITHOUT spending a retry — a resize is not a failure. reset binds
         # slot/lane to None; weights_version survives (every installed
@@ -800,6 +864,11 @@ class DisaggServingEngine(ServingEngine):
         if size is not None:
             self._decode_executables_baseline = size
         self._rstats["resizes"] += 1
+        if tr is not None:
+            tr.end(h_commit, self._stats["ticks"], rebound=rebound,
+                   retried=retried)
+            tr.end(h_resize, self._stats["ticks"], ok=True,
+                   layout_id=self._active_layout_id)
         if _log_ok():
             logger.info(
                 "disagg: resized %d -> %d devices (%d prefill / %d decode, "
@@ -913,6 +982,10 @@ class DisaggServingEngine(ServingEngine):
         alive = [L for L in self._draining_layouts if L.decoding]
         drained = len(self._draining_layouts) - len(alive)
         if drained:
+            if self.tracing is not None:
+                for L in self._draining_layouts:
+                    if not L.decoding and L.trace_span is not None:
+                        self.tracing.end(L.trace_span, self._stats["ticks"])
             self._draining_layouts = alive
             self._rstats["drained_layouts"] += drained
             if _log_ok():

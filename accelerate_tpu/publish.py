@@ -159,11 +159,23 @@ class WeightPublisher:
     """
 
     def __init__(self, engine, config: Optional[PublishConfig] = None, *,
-                 chaos=None, telemetry=None):
+                 chaos=None, telemetry=None, tracing=None):
         self.engine = engine
         self.config = config if config is not None else PublishConfig()
         self.chaos = chaos
         self.telemetry = telemetry
+        # Trace recorder (tracing.py): explicit, else the telemetry
+        # recorder's, else whatever the engine is already tracing with —
+        # publish phase spans then land in the same request timeline.
+        self.tracing = tracing
+        if self.tracing is None:
+            self.tracing = getattr(telemetry, "tracing", None)
+        if self.tracing is None:
+            self.tracing = getattr(engine, "tracing", None)
+        if self.tracing is not None and chaos is not None:
+            self.tracing.attach_chaos(chaos)
+        if self.tracing is not None:
+            self.tracing.register_gauges("publish", self.stats)
         self._executor = None           # lazy — built on first publish
         self._publish_seq = 0           # chaos tick for publish_* draws
         self._candidate: Optional[dict] = None
@@ -181,6 +193,14 @@ class WeightPublisher:
             "swap_wall_s": 0.0,
         }
         self.history: list[dict] = []   # one record per publish decision
+
+    def _tick(self) -> int:
+        """The engine's tick clock — publish spans share the serving
+        timeline so a trace shows which decode ticks a publish overlapped."""
+        try:
+            return int(self.engine._stats["ticks"])
+        except (AttributeError, KeyError, TypeError):
+            return 0
 
     # -- the watch loop ----------------------------------------------------
 
@@ -235,6 +255,10 @@ class WeightPublisher:
                         "commit a newer step to recover"
                     )
                 continue
+            if self.tracing is not None and version > int(
+                    self.engine.weights_version) and version not in self._vetoed:
+                self.tracing.instant("publish", "scan", self._tick(),
+                                     version=version)
             if version <= int(self.engine.weights_version):
                 if self._last_refused != version:
                     self._last_refused = version
@@ -297,10 +321,19 @@ class WeightPublisher:
                 )
             return None
 
+        tr = self.tracing
+        h_pub = None
+        if tr is not None:
+            h_pub = tr.begin("publish", f"publish[v{version}]", self._tick(),
+                             seq=seq, version=version)
+
         # Chaos gate 1: the manifest trust boundary. An injected torn_write
         # reads as a torn manifest, version_mismatch as a stale version —
         # both refuse the checkpoint through the same code path as the real
         # condition, and the old version keeps serving.
+        h_verify = None
+        if tr is not None:
+            h_verify = tr.begin("publish", "verify", self._tick())
         fault = None
         if self.chaos is not None:
             fault = self.chaos.draw("publish_manifest", seq, unit=version)
@@ -312,6 +345,9 @@ class WeightPublisher:
                     "(injected torn write); old version %d keeps serving",
                     ckpt_dir, self.engine.weights_version,
                 )
+            if tr is not None:
+                tr.end(h_verify, self._tick(), ok=False)
+                tr.end(h_pub, self._tick(), ok=False, reason="torn_manifest")
             return None
         if fault is not None and fault.kind == "version_mismatch":
             self._stats["skipped_stale"] += 1
@@ -322,6 +358,10 @@ class WeightPublisher:
                     "keeps serving",
                     ckpt_dir, version, self.engine.weights_version,
                 )
+            if tr is not None:
+                tr.end(h_verify, self._tick(), ok=False)
+                tr.end(h_pub, self._tick(), ok=False,
+                       reason="version_mismatch")
             return None
         ok, reason = verify_checkpoint(ckpt_dir,
                                        check_hashes=cfg.check_hashes)
@@ -329,8 +369,16 @@ class WeightPublisher:
             self._stats["skipped_unverified"] += 1
             if _log_ok():
                 logger.warning("publish: refusing %r — %s", ckpt_dir, reason)
+            if tr is not None:
+                tr.end(h_verify, self._tick(), ok=False)
+                tr.end(h_pub, self._tick(), ok=False, reason="unverified")
             return None
+        if tr is not None:
+            tr.end(h_verify, self._tick(), ok=True)
 
+        h_redist = None
+        if tr is not None:
+            h_redist = tr.begin("publish", "redistribute", self._tick())
         host_tree, prefix = self._load_weights(ckpt_dir)
         schedule, predicted_s, n_devices = self._plan(host_tree, ckpt_dir,
                                                       prefix)
@@ -343,7 +391,14 @@ class WeightPublisher:
 
         new_params = self._transfer(host_tree, prefix, seq, version)
         if new_params is None:
+            if tr is not None:
+                tr.end(h_redist, self._tick(), ok=False)
+                tr.end(h_pub, self._tick(), ok=False,
+                       reason="transfer_aborted")
             return None  # aborted — retries exhausted
+        if tr is not None:
+            tr.end(h_redist, self._tick(), ok=True,
+                   bytes=int(moved_bytes))
 
         t0 = time.perf_counter()
         if float(cfg.canary_fraction) >= 1.0:
@@ -362,6 +417,16 @@ class WeightPublisher:
         swap_s = time.perf_counter() - t0
         self._stats["swap_wall_s"] += swap_s
         self._stats["published"] += 1
+        if tr is not None:
+            if mode == "canary":
+                # The canary window outlives this call — a detached span
+                # closed by maybe_decide() when the cohort verdict lands.
+                self._candidate["trace_span"] = tr.begin(
+                    "publish", f"canary_window[v{version}]", self._tick(),
+                    detached=True, version=version,
+                    fraction=float(cfg.canary_fraction))
+            tr.end(h_pub, self._tick(), ok=True, mode=mode,
+                   swap_s=round(swap_s, 6))
         record = {
             "action": "published", "mode": mode, "version": version,
             "ckpt_dir": ckpt_dir, "bytes": int(moved_bytes),
@@ -577,6 +642,15 @@ class WeightPublisher:
             window = self.engine.promote_canary()
             self._stats["promoted"] += 1
             action = "promoted"
+        if self.tracing is not None:
+            h_win = cand.get("trace_span")
+            if h_win is not None:
+                self.tracing.end(h_win, self._tick(), action=action,
+                                 n_reasons=len(reasons))
+            self.tracing.instant(
+                "publish", "decide", self._tick(), action=action,
+                version=cand["version"],
+                reason=(reasons[0] if reasons else ""))
         record = {
             "action": action, "version": cand["version"],
             "reasons": reasons,

@@ -446,13 +446,20 @@ class ServingEngine:
 
     def __init__(self, model, config=None, *, forward_cached: Optional[Callable] = None,
                  compile_manager=None, telemetry=None, fault_tolerance=None,
-                 chaos=None):
+                 chaos=None, tracing=None):
         from .utils.dataclasses import ServingConfig
 
         self.config = config if config is not None else ServingConfig()
         self.model = model
         self.telemetry = telemetry
         self.fault_tolerance = fault_tolerance
+        # Request-scoped tracing (tracing.py). Defaults to the telemetry
+        # recorder's TraceRecorder (TelemetryKwargs(tracing=...)) so the
+        # accelerator wiring enables both with one knob; a standalone
+        # recorder can also be passed directly. None -> every hook is one
+        # ``is None`` check, same zero-cost contract as telemetry/chaos.
+        self.tracing = tracing if tracing is not None else getattr(
+            telemetry, "tracing", None)
         self.chaos = chaos
         name = type(model.module).__name__
         if forward_cached is not None:
@@ -579,6 +586,24 @@ class ServingEngine:
         self._draining = False
         self._idle_ticks = 0
         self._has_deadlines = self.config.deadline_s is not None
+        if self.tracing is not None:
+            # metrics_text() parity: the Prometheus snapshot reads the same
+            # live stats() dict external callers see.
+            self.tracing.register_gauges("serving", self.stats)
+
+    @property
+    def chaos(self):
+        """The attached :class:`~accelerate_tpu.chaos.FaultInjector` (or
+        None). A property so late attachment (the smokes arm chaos AFTER
+        warmup, once ``reset_metrics`` re-zeroed the tick clock) still
+        wires the tracing annotation callback."""
+        return self._chaos
+
+    @chaos.setter
+    def chaos(self, injector) -> None:
+        self._chaos = injector
+        if injector is not None and self.tracing is not None:
+            self.tracing.attach_chaos(injector)
 
     # -- request lifecycle -------------------------------------------------
 
@@ -622,6 +647,11 @@ class ServingEngine:
         self._stats["submitted"] += 1
         if self._first_submit_t is None:
             self._first_submit_t = req.submit_t
+        if self.tracing is not None:
+            self.tracing.request_submitted(
+                req.id, self._stats["ticks"], req.submit_t,
+                prompt_tokens=int(tokens.size), budget=budget,
+                deadline_s=float(dl) if dl is not None else None)
         if self._draining:  # preemption drain: nothing new gets in
             self._finish(req, "shed")
             return req.id
@@ -791,6 +821,11 @@ class ServingEngine:
             self._stats["slot_reuses"] += 1
         self._used_slots.add(slot)
         self._prefilling.append(req)
+        if self.tracing is not None:
+            self.tracing.request_granted(
+                req.id, self._stats["ticks"], req.admit_t, slot=slot,
+                lane=req.lane, weights_version=req.weights_version,
+                canary=bool(req.canary))
 
     def _admit(self) -> None:
         while self._free and self._queue:
@@ -806,6 +841,8 @@ class ServingEngine:
         chunk[0, :valid] = req.tokens[req.consumed:req.consumed + valid]
         is_first = req.next_chunk == 0
         is_final = req.next_chunk == len(req.chunks) - 1
+        tr = self.tracing
+        t0 = time.perf_counter() if tr is not None else None
         try:
             if self.chaos is not None:
                 fault = self.chaos.draw("prefill_dispatch",
@@ -823,10 +860,18 @@ class ServingEngine:
         req.consumed += valid
         self._stats["prefill_chunks"] += 1
         self._stats["prefill_pad_tokens"] += size - valid
+        if tr is not None:
+            tr.prefill_chunk(req.id, self._stats["ticks"], t0,
+                             time.perf_counter(), size=size, valid=valid,
+                             lane=req.lane, slot=req.slot,
+                             index=req.next_chunk - 1, final=is_final)
         if is_final:
             self._prefilling.remove(req)
             req.first_token_t = time.perf_counter()
             req.out.append(int(tok))  # small host fetch — the TTFT moment
+            if tr is not None:
+                tr.first_token(req.id, self._stats["ticks"],
+                               req.first_token_t)
             if bool(done0):
                 self._retire(req)
             else:
@@ -871,7 +916,12 @@ class ServingEngine:
         live = len(self._decoding)
         self._stats["occupancy_sum"] += live
         self._stats["peak_occupancy"] = max(self._stats["peak_occupancy"], live)
+        tr = self.tracing
         for version, mask in self._decode_groups():
+            if tr is not None:
+                t0 = time.perf_counter()
+                group_rids = [r.id for s, r in self._decoding.items()
+                              if r.weights_version == version and mask[s]]
             self._cache, self._state, tok, bad = self._decode(
                 self._params_for(version), self._cache, self._state, mask
             )
@@ -901,6 +951,10 @@ class ServingEngine:
                 if bool(done_np[slot]):
                     del self._decoding[slot]
                     self._retire(req)
+            if tr is not None:
+                tr.decode_tick(self._stats["ticks"], t0, time.perf_counter(),
+                               weights_version=version, occupancy=live,
+                               n_slots=self.n_slots, request_ids=group_rids)
         size = _cache_size(self._decode)
         if size is not None:
             if self._decode_executables_baseline is None:
@@ -966,6 +1020,10 @@ class ServingEngine:
         })
         if len(self._params_by_version) > 1:
             self._gc_versions()
+        if self.tracing is not None:
+            self.tracing.request_finished(
+                req.id, self._stats["ticks"], req.done_t, status=status,
+                new_tokens=n_new, weights_version=req.weights_version)
         if self.telemetry is not None:
             self.telemetry.record_event(
                 "serving_request_done", request_id=req.id, status=status,
@@ -1031,6 +1089,10 @@ class ServingEngine:
         self._fstats["retries"] += 1
         req.reset_for_retry()
         self._queue.appendleft(req)
+        if self.tracing is not None:
+            self.tracing.request_retry(req.id, self._stats["ticks"],
+                                       reason=reason or "retry",
+                                       attempt=req.retries)
 
     def _on_prefill_failure(self, req: _Request, exc: Exception) -> None:
         """A prefill chunk dispatch (or disagg handoff) failed after its own
@@ -1064,6 +1126,8 @@ class ServingEngine:
     def _quarantine_slot(self, slot: int) -> None:
         self._quarantined_slots.add(slot)
         self._fstats["slot_quarantines"] += 1
+        if self.tracing is not None:
+            self.tracing.quarantine("slot", slot, self._stats["ticks"])
         self._state = _release_step(self._state, np.int32(slot))
         if _log_ok():
             logger.warning(
@@ -1424,6 +1488,10 @@ class ServingEngine:
         self._window.clear()
         self._queue_depth_window.clear()
         self._finished.clear()
+        if self.tracing is not None:
+            # The trace restarts with the metrics: warmup spans would
+            # otherwise pollute explain()/the tick-domain replay invariant.
+            self.tracing.reset()
 
     # -- reporting ---------------------------------------------------------
 

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from .logging import get_logger
+from .tracing import TraceConfig, TraceRecorder
 from .utils.memory import get_device_memory_stats, live_bytes_on_device
 from .utils.operations import collective_counters, gather
 
@@ -195,6 +196,18 @@ class TelemetryRecorder:
         self._plan_path: Optional[str] = None
         self._plan_calibrate_after = 0
         self._plan_calibration: Optional[dict] = None
+        # Request-scoped tracing (tracing.py): built from the handler's
+        # ``tracing`` knob; serving engines constructed through the
+        # accelerator pick it up from here, and summary() grows a
+        # "tracing" block. None when off — same zero-cost contract as
+        # every other hook in this file.
+        self.tracing = None
+        tr_cfg = TraceConfig.from_value(getattr(handler, "tracing", None))
+        if tr_cfg is not None:
+            self.tracing = TraceRecorder(tr_cfg)
+        # JSONL rotation state (handler.max_log_bytes): one warning on the
+        # first rotation, then silent.
+        self._rotated_once = False
         # Counters are process-global (utils/operations.py); a new recorder
         # means a new run's tally.
         collective_counters.reset()
@@ -497,6 +510,15 @@ class TelemetryRecorder:
                 wd["warnings"] += 1
             wd["last_straggler"] = fields.get("straggler")
             wd["last_ages_s"] = fields.get("ages_s")
+        if self.tracing is not None:
+            # Checkpoint save/restore and watchdog stalls get trace spans
+            # through this one forwarding point — checkpointing.py and
+            # fault_tolerance.py already report here.
+            try:
+                self.tracing.on_event(event, fields, self.step)
+            except Exception:
+                logger.warning_once(f"telemetry: trace forwarding failed "
+                                    f"for {event!r}")
         record = {"event": event, "step": self.step, "time": time.time()}
         record.update(fields)
         self._write(record)
@@ -674,7 +696,38 @@ class TelemetryRecorder:
             # Line-buffered: each record is durable on its newline, so a
             # preempted run keeps every completed step's row.
             self._fh = open(self.path, "a", buffering=1)
+        # Clock hygiene: every record carries a monotonic timestamp next to
+        # its wall "time". Durations must be computed from t_mono deltas —
+        # an NTP step can move time.time() backwards mid-run, and a
+        # negative "step time" from subtracted wall clocks has burned real
+        # postmortems. (The step/straggler walls in this file are already
+        # perf_counter deltas measured by the callers.)
+        record.setdefault("t_mono", time.perf_counter())
         self._fh.write(json.dumps(record) + "\n")
+        self._maybe_rotate()
+
+    def _maybe_rotate(self):
+        """Size-triggered JSONL rotation: a long serving run must not grow
+        the per-rank file without bound. One rotation generation
+        (``rank_N.jsonl.1``) is kept — crash-safe via os.replace."""
+        limit = getattr(self.handler, "max_log_bytes", None)
+        if not limit or self._fh is None:
+            return
+        try:
+            if self._fh.tell() < int(limit):
+                return
+            self._fh.close()
+            os.replace(self.path, self.path + ".1")
+            self._fh = open(self.path, "a", buffering=1)
+            if not self._rotated_once:
+                self._rotated_once = True
+                logger.warning_once(
+                    f"telemetry: {self.path} crossed max_log_bytes="
+                    f"{int(limit)} and was rotated to {self.path}.1 — "
+                    "raise TelemetryKwargs.max_log_bytes to keep more."
+                )
+        except OSError as e:
+            logger.warning_once(f"telemetry: log rotation failed: {e}")
 
     def _forward_to_trackers(self, record: dict):
         every = self.handler.log_every
@@ -757,6 +810,10 @@ class TelemetryRecorder:
             # Auto-parallelism plan block (planner.py): predicted vs
             # measured step time / peak HBM + calibration state.
             out["plan"] = plan_block
+        if self.tracing is not None:
+            # Tracing block (tracing.py): span/request/flow census — the
+            # aggregate face of the per-request span machinery.
+            out["tracing"] = self.tracing.stats()
         # Executable census: total dispatch-cache size across the watched
         # jitted fns — the number shape bucketing caps at len(buckets).
         sizes = [e["cache_size"] for e in self._watch.values() if e["cache_size"]]

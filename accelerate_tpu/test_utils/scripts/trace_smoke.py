@@ -1,0 +1,212 @@
+"""`make trace-smoke`: the request-tracing acceptance loop on the CPU mesh.
+
+24 mixed-length requests arrive as a seeded Poisson trace — driven by the
+TICK clock, so arrivals, scheduling, and every chaos draw replay exactly —
+through a disaggregated engine with a :class:`TraceRecorder` attached and
+rate-driven handoff transfer errors riding the KV page stream.
+
+Asserts:
+
+- every ``poll()`` row's request carries a complete span tree (queued span,
+  >=1 prefill chunk, exactly one finish) and ``explain()`` resolves it;
+- the critical-path terms telescope: ``sum(terms) == measured TTFT`` within
+  float tolerance for every first-token request;
+- the Chrome trace JSON parses and stitches each KV handoff across lanes
+  with paired flow events (``"s"`` on the prefill-lane handoff span, ``"f"``
+  on the decode-side insert, shared id, different pids);
+- a second identically-seeded run produces a BIT-IDENTICAL tick-domain
+  trace (``tick_trace()`` JSON compares equal);
+- the decode steady state stays ONE executable with zero post-warmup
+  recompiles — tracing is host-side only;
+- throughput stays within 5% of the tracing-off run on the same trace
+  (wall-clock on shared CI cores is noisy; the bar gets re-measurements on
+  fresh engines before failing — everything else is deterministic).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+N_REQUESTS = 24
+N_SLOTS = 12
+N_LANES = 2
+CHAOS_SEED = 13
+THROUGHPUT_TOL = 0.05  # tracing overhead bar: within 5% of tracing-off
+MAX_TICKS = 200_000
+TIMING_ATTEMPTS = 4
+
+
+def _workload(cfg):
+    rng = np.random.default_rng(11)
+    lengths = [int(rng.integers(40, 65)) if rng.random() < 0.25
+               else int(rng.integers(6, 17)) for _ in range(N_REQUESTS)]
+    budgets = [int(rng.integers(8, 17)) for _ in range(N_REQUESTS)]
+    prompts = [rng.integers(1, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in lengths]
+    gaps = rng.exponential(2.0, size=N_REQUESTS)
+    arrival_ticks = np.floor(np.cumsum(gaps)).astype(int).tolist()
+    return prompts, budgets, arrival_ticks
+
+
+def main():
+    print(json.dumps({"row": "start", "requests": N_REQUESTS}), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import (
+        DisaggConfig,
+        DisaggServingEngine,
+        FaultInjector,
+        Model,
+        ServingConfig,
+        TraceConfig,
+        TraceRecorder,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import set_seed
+
+    if len(jax.devices()) < 2:
+        raise SystemExit(
+            "trace-smoke needs a multi-device platform; run via "
+            "`make trace-smoke` (XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8)"
+        )
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    probe = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8),
+                                              dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+
+    prompts, budgets, arrival_ticks = _workload(cfg)
+    sc = ServingConfig(n_slots=N_SLOTS, max_len=96, prefill_chunks=[16, 32],
+                       temperature=0.0, seed=0, max_retries=3,
+                       max_idle_ticks=200)
+    dc = DisaggConfig(n_prefill_lanes=N_LANES, handoff_retries=3)
+
+    def make_chaos():
+        return FaultInjector(
+            seed=CHAOS_SEED,
+            rates={"handoff_device_put": {"transfer_error": 0.10}},
+        )
+
+    def build(tracing):
+        eng = DisaggServingEngine(model, sc, disagg=dc, tracing=tracing)
+        eng.warmup()       # reset_metrics() re-zeroes the tick clock AND the
+        eng.chaos = make_chaos()  # trace, so seeded draws replay exactly
+        return eng
+
+    def replay(eng):
+        ids, results = {}, {}
+        nxt = t = 0
+        while nxt < N_REQUESTS or eng.pending:
+            while nxt < N_REQUESTS and arrival_ticks[nxt] <= t:
+                ids[nxt] = eng.submit(prompts[nxt],
+                                      max_new_tokens=budgets[nxt])
+                nxt += 1
+            eng.tick()
+            for r in eng.poll():
+                results[r["id"]] = r
+            t += 1
+            assert t < MAX_TICKS, "outer tick backstop tripped"
+        return ids, [results[ids[i]] for i in range(N_REQUESTS)], eng.stats()
+
+    tr1 = TraceRecorder(TraceConfig())
+    ids1, rows1, s1 = replay(build(tr1))
+
+    # --- 1. every row has a complete span tree + explain() resolves -------
+    for row in rows1:
+        rid = row["id"]
+        kinds = {}
+        for s in tr1.spans(rid):
+            kinds[s.kind] = kinds.get(s.kind, 0) + 1
+        assert kinds.get("queued", 0) >= 1, (rid, kinds)
+        assert kinds.get("finish", 0) == 1, (rid, kinds)
+        if row["status"] == "ok":
+            assert kinds.get("prefill_chunk", 0) >= 1, (rid, kinds)
+            assert kinds.get("handoff", 0) >= 1, (rid, kinds)
+        rep = tr1.explain(rid)
+        assert rep["status"] == row["status"], (rep["status"], row["status"])
+        assert rep["n_spans"] == sum(kinds.values())
+
+    # --- 2. the telescoping identity --------------------------------------
+    explained = 0
+    backoffs = 0
+    for row in rows1:
+        rep = tr1.explain(row["id"])
+        if rep["terms"] is None:
+            continue  # never reached a first token (shed/failed pre-prefill)
+        explained += 1
+        total = sum(rep["terms"].values())
+        assert abs(total - rep["ttft_s"]) <= 1e-9 + 1e-9 * abs(rep["ttft_s"]), (
+            f"request {row['id']}: terms sum {total} != ttft {rep['ttft_s']}")
+        assert rep["dominant"] in rep["terms"]
+        if rep["terms"]["backoff_s"] > 0:
+            backoffs += 1
+    assert explained > 0, "no request reached a first token"
+    fstats = s1["faults"]
+    assert fstats["injected"] > 0, "seeded chaos injected nothing"
+    if fstats["handoff_retries"] > 0:
+        assert backoffs > 0, "retried handoffs must show up as backoff terms"
+
+    # --- 3. Chrome trace parses with cross-lane flow events ---------------
+    out_path = "/tmp/trace_smoke_perfetto.json"
+    tr1.export_chrome_trace(out_path)
+    with open(out_path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+    starts = {e["id"]: e for e in events if e["ph"] == "s"}
+    finishes = {e["id"]: e for e in events if e["ph"] == "f"}
+    paired = set(starts) & set(finishes)
+    assert paired, "no KV handoff stitched prefill->decode"
+    for fid in paired:
+        assert pid_names[starts[fid]["pid"]] == "handoff"
+        assert pid_names[finishes[fid]["pid"]] == "decode"
+        assert starts[fid]["ts"] <= finishes[fid]["ts"]
+
+    # --- 4. seeded replay: bit-identical tick-domain trace ----------------
+    tr2 = TraceRecorder(TraceConfig())
+    _, rows2, _ = replay(build(tr2))
+    j1 = json.dumps(tr1.tick_trace(), sort_keys=True)
+    j2 = json.dumps(tr2.tick_trace(), sort_keys=True)
+    assert j1 == j2, "tick-domain trace diverged between seeded runs"
+    assert [r["status"] for r in rows1] == [r["status"] for r in rows2]
+
+    # --- 5. serving invariants untouched ----------------------------------
+    assert s1["decode_executables"] == 1, (
+        f"decode compiled {s1['decode_executables']} executables, want 1")
+    assert s1["steady_recompiles"] == 0, (
+        f"{s1['steady_recompiles']} steady-state recompiles, want 0")
+
+    # --- 6. throughput within 5% of tracing-off ---------------------------
+    ratio = None
+    for attempt in range(TIMING_ATTEMPTS):
+        _, _, s_off = replay(build(None))
+        _, _, s_on = replay(build(TraceRecorder(TraceConfig())))
+        ratio = s_on["tokens_per_s"] / s_off["tokens_per_s"]
+        if ratio >= 1.0 - THROUGHPUT_TOL:
+            break
+    assert ratio >= 1.0 - THROUGHPUT_TOL, (
+        f"tracing costs {100 * (1 - ratio):.1f}% throughput "
+        f"(> {100 * THROUGHPUT_TOL:.0f}% bar) after {TIMING_ATTEMPTS} tries")
+
+    print(json.dumps({
+        "row": "ok",
+        "requests": N_REQUESTS,
+        "spans": tr1.stats()["spans"],
+        "flows": len(paired),
+        "injected": fstats["injected"],
+        "explained": explained,
+        "tick_trace_reproduced": True,
+        "throughput_ratio": round(ratio, 4),
+        "perfetto": out_path,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

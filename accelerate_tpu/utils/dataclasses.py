@@ -281,6 +281,18 @@ class TelemetryKwargs(KwargsHandler):
     - ``memory_every``: sample device-memory stats every N steps (some
       backends make ``memory_stats()`` a sync point).
     - ``output_dir``: JSONL destination; default ``<project_dir>/telemetry``.
+    - ``max_log_bytes``: size-triggered rotation bound for the per-rank
+      JSONL — when the live file crosses it, it is renamed to
+      ``<name>.jsonl.1`` (replacing any previous rotation) and a fresh
+      file starts, with a one-time warning. Generous but finite by
+      default; ``None``/0 disables rotation.
+    - ``tracing``: request-scoped tracing (tracing.py). ``True`` (default
+      recorder), a dict of :class:`~accelerate_tpu.tracing.TraceConfig`
+      field overrides, or a ``TraceConfig``. The recorder lands on
+      ``telemetry.tracing``, serving engines built through the
+      accelerator inherit it, and ``summary()`` gains a ``"tracing"``
+      block. Off (None) means zero cost: every hook is one ``is None``
+      check.
     """
 
     enabled: bool = True
@@ -291,6 +303,8 @@ class TelemetryKwargs(KwargsHandler):
     ema_alpha: float = 0.1
     memory_every: int = 1
     output_dir: Optional[str] = None
+    max_log_bytes: Optional[int] = 256 * 1024 * 1024
+    tracing: Any = None
 
 
 @dataclass

@@ -204,6 +204,7 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
                 straggler_probe_every=0,
                 log_every=0,
                 output_dir=tempfile.mkdtemp(prefix="bench_telemetry_"),
+                tracing=bool(os.environ.get("BENCH_TRACE_OUT")),
             )
         ],
     )
@@ -250,6 +251,10 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
     telemetry = acc.telemetry.summary() if acc.telemetry is not None else None
+    trace_out = os.environ.get("BENCH_TRACE_OUT")
+    if (trace_out and acc.telemetry is not None
+            and getattr(acc.telemetry, "tracing", None) is not None):
+        acc.telemetry.tracing.export_chrome_trace(trace_out)
 
     devices = jax.devices()
     n_devices = len(devices)
@@ -389,6 +394,19 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                           "resplits", "dead_device_shrinks", "resizes",
                           "aborts", "flap_damped", "active_devices")
             }
+        # Tracing block (tracing.py via telemetry summary): span counts ride
+        # along when --trace-out armed a TraceRecorder, so a traced round's
+        # rows say how much span traffic the exported Perfetto file holds.
+        if t.get("tracing"):
+            tb = t["tracing"]
+            result["telemetry"]["tracing"] = {
+                k: tb.get(k)
+                for k in ("spans", "dropped_spans", "by_kind", "requests",
+                          "flows")
+            }
+            if os.environ.get("BENCH_TRACE_OUT"):
+                result["telemetry"]["tracing"]["trace_out"] = (
+                    os.environ["BENCH_TRACE_OUT"])
     # Stream the seq-2048 row the moment it exists — a kill during the 8192
     # phase must not erase it (round-3 postmortem).
     _emit(round(r2k["tok_s"], 1), unit_2k("; seq-8192 pending"),
@@ -752,7 +770,14 @@ def main() -> int:
     parser.add_argument("--child", action="store_true")
     parser.add_argument("--oom-level", type=int, default=0)
     parser.add_argument("--budget-s", type=float, default=1e9)
+    parser.add_argument("--trace-out", type=str, default=None,
+                        help="enable request tracing and dump the child's "
+                             "Chrome/Perfetto trace JSON to this path")
     args = parser.parse_args()
+    if args.trace_out:
+        # Children inherit os.environ, so the supervisor's flag reaches every
+        # retry attempt without widening the --child argv contract.
+        os.environ["BENCH_TRACE_OUT"] = args.trace_out
     if args.child:
         return child(args.oom_level, args.budget_s)
     return supervise()

@@ -91,6 +91,30 @@ def build(params_b: float):
     return cfg
 
 
+def _tracing_block(tr):
+    """The bench-row ``tracing`` block: span counts by kind plus the
+    critical-path breakdown of the p95-TTFT request — the one the SLO
+    report would name when asked "why is tail latency what it is?"."""
+    stats = tr.stats()
+    block = {"spans": stats["spans"], "by_kind": stats["by_kind"]}
+    pairs = []
+    for rid in tr.request_ids():
+        rep = tr.explain(rid)
+        if rep["terms"] is not None:
+            pairs.append((rep["ttft_s"], rid))
+    if pairs:
+        pairs.sort()
+        p95 = float(np.percentile([p[0] for p in pairs], 95))
+        ttft, rid = next((p for p in pairs if p[0] >= p95), pairs[-1])
+        rep = tr.explain(rid)
+        block["p95_request"] = {
+            "request_id": rid, "ttft_s": round(ttft, 6),
+            "dominant": rep["dominant"],
+            "terms": {k: round(v, 6) for k, v in rep["terms"].items()},
+        }
+    return block
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--params-b", type=float, default=1.0)
@@ -125,6 +149,13 @@ def main():
     ap.add_argument("--qps", type=float, default=8.0,
                     help="Poisson arrival rate for the serving rows (the "
                          "diurnal trace's low-plateau rate)")
+    ap.add_argument("--tracing", action="store_true",
+                    help="attach a TraceRecorder to every serving row and "
+                         "embed a tracing block (span counts + critical-path "
+                         "breakdown of the p95-TTFT request)")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="dump the last traced serving row as Perfetto-"
+                         "loadable Chrome trace JSON (implies --tracing)")
     ap.add_argument("--trace", choices=("poisson", "diurnal"),
                     default="poisson",
                     help="arrival process shared by every serving row")
@@ -132,6 +163,8 @@ def main():
     args = ap.parse_args()
     if args.autoscale:
         args.trace = "diurnal"
+    if args.trace_out:
+        args.tracing = True
     if args.disagg or args.chaos or args.publish or args.autoscale:
         args.serving = True
 
@@ -233,6 +266,15 @@ def main():
         from accelerate_tpu import generation as G
         from accelerate_tpu.generation import clear_generation_cache
 
+        def _recorder():
+            if not args.tracing:
+                return None
+            from accelerate_tpu import TraceConfig, TraceRecorder
+
+            return TraceRecorder(TraceConfig())
+
+        export_tr = None  # the last traced row's recorder (--trace-out)
+
         srng = np.random.default_rng(1)
         n, slots = args.requests, args.slots
         phases = None
@@ -310,12 +352,13 @@ def main():
         t_cap = int(max(lengths[i] + budgets[i] for i in range(n))) + 8
         scfg = ServingConfig(n_slots=slots, max_len=t_cap,
                              max_prefill_chunk=max(16, args.prompt_len))
-        engine = ServingEngine(res_model, scfg)
+        tr_serve = _recorder()
+        engine = ServingEngine(res_model, scfg, tracing=tr_serve)
         engine.warmup()
         _, serve_s = replay_trace(engine, reqs, arrivals=list(arrivals),
                                   max_new_tokens=[int(b) for b in budgets])
         st = engine.stats()
-        print(json.dumps({
+        row = {
             "row": "serving", "seconds": round(serve_s, 3),
             "useful_tokens": st["tokens_out"],
             "tokens_per_s": st["tokens_per_s"],
@@ -326,7 +369,11 @@ def main():
             "decode_executables": st["decode_executables"],
             "prefill_executables": st["prefill_executables"],
             "steady_recompiles": st["steady_recompiles"],
-        }), flush=True)
+        }
+        if tr_serve is not None:
+            row["tracing"] = _tracing_block(tr_serve)
+            export_tr = tr_serve
+        print(json.dumps(row), flush=True)
 
         # Disaggregated row: the same trace through the two-mesh router —
         # planner-sized prefill/decode slices, streamed KV-page handoff. The
@@ -339,14 +386,16 @@ def main():
         elif args.disagg:
             from accelerate_tpu import DisaggConfig, DisaggServingEngine
 
+            tr_dis = _recorder()
             dengine = DisaggServingEngine(
                 res_model, scfg, disagg=DisaggConfig(n_prefill_lanes=args.lanes),
+                tracing=tr_dis,
             )
             dengine.warmup()
             _, dis_s = replay_trace(dengine, reqs, arrivals=list(arrivals),
                                     max_new_tokens=[int(b) for b in budgets])
             dst = dengine.stats()
-            print(json.dumps({
+            row = {
                 "row": "serving_disagg", "seconds": round(dis_s, 3),
                 "useful_tokens": dst["tokens_out"],
                 "tokens_per_s": dst["tokens_per_s"],
@@ -356,7 +405,11 @@ def main():
                 "decode_executables": dst["decode_executables"],
                 "steady_recompiles": dst["steady_recompiles"],
                 "disagg": dst["disagg"],
-            }), flush=True)
+            }
+            if tr_dis is not None:
+                row["tracing"] = _tracing_block(tr_dis)
+                export_tr = tr_dis
+            print(json.dumps(row), flush=True)
 
         # Chaos row: the same trace under a deterministic FaultInjector —
         # the robustness overhead (retries, quarantines, degraded fallback)
@@ -379,14 +432,16 @@ def main():
                                  max_prefill_chunk=max(16, args.prompt_len),
                                  max_retries=3,
                                  max_idle_ticks=max(100, 4 * t_cap))
+            tr_chaos = _recorder()
             if use_disagg:
                 from accelerate_tpu import DisaggConfig, DisaggServingEngine
 
                 cengine = DisaggServingEngine(
                     res_model, ccfg,
-                    disagg=DisaggConfig(n_prefill_lanes=args.lanes))
+                    disagg=DisaggConfig(n_prefill_lanes=args.lanes),
+                    tracing=tr_chaos)
             else:
-                cengine = ServingEngine(res_model, ccfg)
+                cengine = ServingEngine(res_model, ccfg, tracing=tr_chaos)
             cengine.warmup()   # compiles out of TTFT; the tick clock re-zeroes
             cengine.chaos = chaos  # attach after warmup: draws stay replayable
             _, cha_s = replay_trace(cengine, reqs, arrivals=list(arrivals),
@@ -406,6 +461,9 @@ def main():
             if use_disagg:
                 row["degraded"] = cst["disagg"]["degraded"]
                 row["healthy_lanes"] = cst["disagg"]["healthy_lanes"]
+            if tr_chaos is not None:
+                row["tracing"] = _tracing_block(tr_chaos)
+                export_tr = tr_chaos
             print(json.dumps(row), flush=True)
 
         # Publish row: hot-swap a committed, manifest-verified checkpoint
@@ -567,6 +625,12 @@ def main():
                 "prefill_executables": ast["prefill_executables"],
                 "steady_recompiles": ast["steady_recompiles"],
             }), flush=True)
+
+        if args.trace_out and export_tr is not None:
+            export_tr.export_chrome_trace(args.trace_out)
+            print(json.dumps({"row": "trace_out", "path": args.trace_out,
+                              "spans": export_tr.stats()["spans"]}),
+                  flush=True)
 
     # --- Row 3: streamed (blocks in host RAM, layer streaming) -------------
     base = Model(module=module, params=host_params)

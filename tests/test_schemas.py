@@ -1,0 +1,172 @@
+"""Public observability schema pins: the exact key sets of ``poll()`` rows,
+``stats()`` (including the faults/window/disagg/autoscale blocks), and
+``summary()`` top-level blocks. These dicts are consumed by bench rows,
+smokes, dashboards, and the autoscaler — a silently renamed or dropped key
+breaks them downstream, so additions/removals must update these pins
+deliberately. All CPU-only, tier-1 fast."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import (
+    DisaggConfig,
+    DisaggServingEngine,
+    Model,
+    ServingConfig,
+    ServingEngine,
+)
+from accelerate_tpu.utils import set_seed
+
+
+@pytest.fixture(scope="module")
+def llama():
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    probe = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8),
+                                              dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+    return cfg, model
+
+
+def _prompts(cfg, lengths, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, (n,), dtype=np.int32)
+            for n in lengths]
+
+
+POLL_ROW_KEYS = {
+    "id", "status", "tokens", "new_tokens", "ttft_s", "tpot_s",
+    "weights_version",
+}
+
+SERVING_STATS_KEYS = {
+    "requests_submitted", "requests_completed", "tokens_out",
+    "prompt_tokens_in", "elapsed_s", "tokens_per_s",
+    "ttft_p50_s", "ttft_p95_s", "ttft_queue_wait_mean_s",
+    "ttft_prefill_mean_s", "tpot_mean_s",
+    "ticks", "decode_steps", "prefill_chunks", "prefill_pad_tokens",
+    "prefill_ladder", "n_slots", "mean_occupancy", "peak_occupancy",
+    "mean_queue_depth", "slot_allocs", "slot_reuses", "steady_recompiles",
+    "decode_executables", "prefill_executables", "weights_version",
+    "canary", "window", "faults",
+}
+
+WINDOW_KEYS = {
+    "requests", "capacity", "ok", "ttft_p50_s", "ttft_p95_s",
+    "tpot_p50_s", "tpot_p95_s", "shed_rate", "timeout_rate", "failed_rate",
+    "queue_depth_p95", "prompt_decode_ratio",
+}
+
+FAULTS_KEYS = {
+    "sheds", "timeouts", "failed", "retries", "slot_quarantines",
+    "lane_quarantines", "handoff_retries", "handoff_delays",
+    "promoted", "rolled_back",
+    "injected", "quarantined_slots", "degraded", "preempted",
+}
+
+DISAGG_KEYS = {
+    "slice_plan", "n_prefill_devices", "n_decode_devices",
+    "decode_slot_sharded", "n_prefill_lanes", "handoff_depth",
+    "handoff_transfers", "handoff_inserts", "handoff_bytes",
+    "handoff_final_flushes", "handoff_lat_sampled", "handoff_lat_mean_s",
+    "handoff_lat_p95_s", "quarantined_lanes", "healthy_lanes", "degraded",
+    "measured_flop_ratio", "resize",
+}
+
+AUTOSCALE_KEYS = {
+    "samples", "decisions", "holds", "grows", "shrinks", "resplits",
+    "dead_device_shrinks", "resizes", "aborts", "flap_damped", "spikes",
+    "planner_refusals", "active_devices", "pool_devices", "dead_devices",
+    "cooldown_until_tick", "breach_over", "breach_under", "last_action",
+}
+
+TRACING_STATS_KEYS = {
+    "spans", "dropped_spans", "by_kind", "requests", "open_spans", "flows",
+}
+
+# Blocks summary() may legally contain; anything else is an unpinned leak.
+SUMMARY_ALWAYS = {
+    "steps", "recompiles", "peak_hbm_bytes", "collectives",
+    "checkpoint_events", "checkpoint",
+}
+SUMMARY_OPTIONAL = {
+    "faults", "watchdog", "serving", "reshard", "disagg", "publish",
+    "autoscale", "plan", "tracing", "executables", "compile",
+    "step_time_mean_s", "step_time_p50_s", "step_time_p90_s",
+    "data_wait_mean_s", "ema_samples_per_s", "ema_tokens_per_s",
+}
+
+
+def test_poll_row_schema(llama):
+    cfg, model = llama
+    engine = ServingEngine(
+        model, ServingConfig(n_slots=2, max_len=32, prefill_chunks=[4, 8]))
+    for p in _prompts(cfg, [5, 9]):
+        engine.submit(p, max_new_tokens=2)
+    while engine.pending:
+        engine.tick()
+    rows = engine.poll()
+    assert len(rows) == 2
+    for row in rows:
+        assert set(row) == POLL_ROW_KEYS
+        assert row["status"] == "ok"
+
+
+def test_serving_stats_schema(llama):
+    cfg, model = llama
+    engine = ServingEngine(
+        model, ServingConfig(n_slots=2, max_len=32, prefill_chunks=[4, 8]))
+    engine.run(_prompts(cfg, [5, 9]), max_new_tokens=2)
+    stats = engine.stats()
+    assert set(stats) == SERVING_STATS_KEYS
+    assert set(stats["window"]) == WINDOW_KEYS
+    assert set(stats["faults"]) == FAULTS_KEYS
+
+
+def test_disagg_stats_schema(llama):
+    cfg, model = llama
+    engine = DisaggServingEngine(
+        model,
+        ServingConfig(n_slots=2, max_len=32, prefill_chunks=[4, 8]),
+        disagg=DisaggConfig(n_prefill_lanes=2),
+    )
+    engine.run(_prompts(cfg, [5, 9]), max_new_tokens=2)
+    stats = engine.stats()
+    assert set(stats) == SERVING_STATS_KEYS | {"disagg"}
+    assert set(stats["disagg"]) == DISAGG_KEYS
+
+
+def test_autoscale_stats_schema(llama):
+    from accelerate_tpu import AutoscaleConfig, AutoscaleController
+
+    cfg, model = llama
+    engine = DisaggServingEngine(
+        model,
+        ServingConfig(n_slots=2, max_len=32, prefill_chunks=[4, 8]),
+        disagg=DisaggConfig(n_prefill_lanes=1),
+    )
+    ctl = AutoscaleController(engine, AutoscaleConfig())
+    assert set(ctl.stats()) == AUTOSCALE_KEYS
+
+
+def test_summary_block_schema(tmp_path):
+    from accelerate_tpu import Accelerator, TraceRecorder
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        kwargs_handlers=[TelemetryKwargs(tracing=True, log_every=0)],
+    )
+    out = acc.telemetry.summary()
+    keys = set(out)
+    assert SUMMARY_ALWAYS <= keys
+    assert keys <= SUMMARY_ALWAYS | SUMMARY_OPTIONAL, (
+        f"unpinned summary blocks: {keys - SUMMARY_ALWAYS - SUMMARY_OPTIONAL}")
+    assert isinstance(acc.telemetry.tracing, TraceRecorder)
+    assert set(out["tracing"]) == TRACING_STATS_KEYS
