@@ -8,7 +8,7 @@ PYTEST = $(ENV) python -m pytest -q
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
         reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
-        autoscale-smoke trace-smoke
+        autoscale-smoke trace-smoke gameday-smoke
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -150,6 +150,21 @@ chaos-train-smoke:
 # docs/usage_guides/serving.md "Continuous deployment".
 publish-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.publish_smoke
+
+# Crash-durability game day: the ENTIRE serving stack — train gang
+# committing a verified checkpoint, journaled disagg engine with autoscaler
+# and tracing attached, WeightPublisher — under one seeded chaos schedule
+# that tears a journal append and then hard-kills the engine (os._exit 78)
+# mid-trace. The parent plays supervisor (classify_exit -> "serving-crash"
+# -> zero-backoff relaunch); the resumed child recovers the write-ahead
+# journal: every request reaches an explicit terminal status exactly once
+# (cached pre-crash completions never re-execute, in-flight rows replay
+# bit-equal to an uninterrupted reference), the publisher still promotes
+# post-recovery, decode stays ONE executable, and a second seeded round
+# replays bit-identically. See docs/usage_guides/serving.md
+# "Surviving engine crashes".
+gameday-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.gameday_smoke
 
 # Elastic-serving gate: a seeded diurnal trace (10x rate swing, shifting
 # prompt:decode mix) replays through a disagg engine that starts on half

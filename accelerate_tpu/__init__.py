@@ -84,13 +84,14 @@ from .generation import (  # noqa: E402
 )
 from .serving import ServingEngine, ServingStalledError, replay_trace  # noqa: E402
 from .disagg import DisaggServingEngine  # noqa: E402
+from .journal import RequestJournal  # noqa: E402
 from .publish import PublishConfig, WeightPublisher  # noqa: E402
 from .autoscale import (  # noqa: E402
     AutoscaleConfig,
     AutoscaleController,
     make_diurnal_trace,
 )
-from .chaos import Fault, FaultInjector, InjectedFaultError  # noqa: E402
+from .chaos import Fault, FaultInjector, InjectedFaultError, flush_injected_log  # noqa: E402
 from .tracing import TraceConfig, TraceRecorder  # noqa: E402
 from .utils.dataclasses import (  # noqa: E402
     AutoPlanKwargs,

@@ -1690,7 +1690,7 @@ class Accelerator:
 
     def build_serving_engine(self, model, config: Optional[ServingConfig] = None,
                              disagg: Optional[DisaggConfig] = None, *,
-                             chaos=None, tracing=None):
+                             chaos=None, tracing=None, journal=None):
         """Construct a :class:`~accelerate_tpu.serving.ServingEngine` over
         ``model`` (a prepared/loaded model with params on device), wired to
         this Accelerator's compile manager (prefill-chunk ladder, generation
@@ -1713,7 +1713,10 @@ class Accelerator:
         deterministic fault-injection runs. ``tracing`` takes a
         :class:`~accelerate_tpu.tracing.TraceRecorder`; it defaults to the
         recorder built from ``TelemetryKwargs(tracing=...)``, so most runs
-        only set the kwarg and the engine picks it up through telemetry."""
+        only set the kwarg and the engine picks it up through telemetry.
+        ``journal`` takes a :class:`~accelerate_tpu.journal.RequestJournal`
+        (or is built from ``ServingConfig.journal_dir``) to write-ahead-log
+        every admission for exactly-once crash recovery (journal.py)."""
         cfg = config if config is not None else self.serving_config
         if cfg is None or not cfg.enabled:
             raise ValueError(
@@ -1728,7 +1731,7 @@ class Accelerator:
                 model, cfg, disagg=dcfg,
                 compile_manager=self.compile_manager, telemetry=self.telemetry,
                 fault_tolerance=self.fault_tolerance, chaos=chaos,
-                tracing=tracing,
+                tracing=tracing, journal=journal,
             )
         from .serving import ServingEngine
 
@@ -1736,7 +1739,7 @@ class Accelerator:
             model, cfg,
             compile_manager=self.compile_manager, telemetry=self.telemetry,
             fault_tolerance=self.fault_tolerance, chaos=chaos,
-            tracing=tracing,
+            tracing=tracing, journal=journal,
         )
 
     def build_weight_publisher(self, engine, config=None, *, chaos=None):

@@ -93,6 +93,22 @@ and the disagg router's live resize when constructed with ``chaos=...``):
   inflates the sample's queue-depth/shed signals, exercising the grow path
   without needing real overload in a smoke).
 
+Crash-durability injection points (drawn by the request journal and the
+engines' hard-crash path — journal.py / serving.py):
+
+- ``journal_append`` — one WAL append (``tick`` = engine tick, ``unit`` =
+  request id); ``torn_write`` tears the line mid-record, then the journal
+  re-writes it whole — replay's checksum-skip path runs while durability
+  holds;
+- ``journal_compact`` — the sealed-segment merge; ``torn_write`` aborts the
+  compaction cleanly (staging removed, sealed segments untouched);
+- ``engine_crash`` — the end-of-tick process-death draw (``crash``): the
+  engine flushes telemetry + this injector's log (:func:`flush_injected_log`
+  — the post-mortem schedule is never torn), then hard-exits with
+  :data:`~accelerate_tpu.utils.constants.SERVING_CRASH_EXIT_CODE` (or the
+  schedule entry's ``exit_code``), driving the supervisor's
+  serving-crash → zero-backoff relaunch → journal recovery path.
+
 Off by default everywhere: no injector exists unless you construct one and
 pass it to an engine (``ServingEngine(..., chaos=...)``) or to
 ``FaultToleranceKwargs(chaos=...)``; the import is lazy-safe (numpy only)
@@ -129,6 +145,7 @@ __all__ = [
     "FAULT_KINDS",
     "DEAD_HOST_DEFAULT_EXIT_CODE",
     "deterministic_jitter",
+    "flush_injected_log",
 ]
 
 INJECTION_POINTS = (
@@ -151,12 +168,16 @@ INJECTION_POINTS = (
     "autoscale_decide",
     "resize_transfer",
     "load_spike",
+    # crash-durable serving (journal.py + the engines' hard-crash path)
+    "journal_append",
+    "journal_compact",
+    "engine_crash",
 )
 
 FAULT_KINDS = (
     "transfer_error", "delay", "dead_lane", "poison",
     "nonfinite_grad", "slow_step", "torn_write", "corrupt_batch", "dead_host",
-    "slo_regression", "version_mismatch", "flap", "spike",
+    "slo_regression", "version_mismatch", "flap", "spike", "crash",
 )
 
 # An injected dead host exits 139 (128 + SIGSEGV) unless the schedule entry
@@ -190,6 +211,16 @@ _POINT_KINDS = {
     "autoscale_decide": ("flap",),
     "resize_transfer": ("transfer_error", "delay"),
     "load_spike": ("spike",),
+    # Crash-durable serving (journal.py): a torn journal append is re-written
+    # whole after the detected short write (the replay-side checksum-skip path
+    # gets coverage), a torn compaction aborts cleanly with the sealed
+    # segments untouched, and an engine_crash hard-exits the serving process
+    # (SERVING_CRASH_EXIT_CODE, or the entry's ``exit_code``) after flushing
+    # telemetry + this injector's log — the supervisor relaunch + journal
+    # recovery path.
+    "journal_append": ("torn_write",),
+    "journal_compact": ("torn_write",),
+    "engine_crash": ("crash",),
 }
 
 _MASK = (1 << 64) - 1
@@ -221,6 +252,28 @@ def deterministic_jitter(seed: int, tick: int, attempt: int) -> float:
     """Jitter factor in [0.5, 1.0) for retry backoff — deterministic in its
     inputs so a chaos replay backs off identically."""
     return 0.5 + 0.5 * _u01(seed, "backoff", tick, attempt)
+
+
+def flush_injected_log(injector, telemetry) -> None:
+    """Hard-exit hygiene, shared by every injected process death (serving's
+    ``engine_crash`` and training's ``dead_host``): push the injector's full
+    ``injected`` log through the telemetry recorder AND close it before
+    ``os._exit``, so the post-mortem fault schedule is never torn. Best
+    effort on every edge — a dying process must still die."""
+    if telemetry is not None:
+        if injector is not None:
+            try:
+                telemetry.record_event(
+                    "chaos_injected_log", seed=injector.seed,
+                    injected=list(injector.injected),
+                    summary=injector.summary(),
+                )
+            except Exception:  # pragma: no cover - dying anyway
+                logger.exception("chaos: injected-log flush failed")
+        try:
+            telemetry.close()
+        except Exception:  # pragma: no cover - dying anyway
+            pass
 
 
 class Fault(NamedTuple):

@@ -30,6 +30,7 @@ from .config_args import LaunchConfig, load_config_file
 from ..utils.constants import (
     POISONED_CHECKPOINT_EXIT_CODE,
     PREEMPTION_EXIT_CODE,
+    SERVING_CRASH_EXIT_CODE,
     TRAINING_STALLED_EXIT_CODE,
 )
 
@@ -170,6 +171,11 @@ def classify_exit(rc: int) -> str:
         return "stalled"
     if rc == POISONED_CHECKPOINT_EXIT_CODE:
         return "poisoned"
+    if rc == SERVING_CRASH_EXIT_CODE:
+        # A hard serving-engine death (chaos engine_crash or a real one). The
+        # request journal makes a relaunch immediately productive: recover()
+        # replays the WAL, so the supervisor restarts with zero backoff.
+        return "serving-crash"
     if rc == 137 or rc == -signal.SIGKILL:
         # SIGKILL is almost always the kernel OOM killer on a training host.
         return "oom"
@@ -271,7 +277,8 @@ class GangSupervisor:
             self._dead_streak = 0
         n = self.restarts_used
         self.restarts_used += 1
-        delay = 0.0 if cls == "preempted" else _backoff_s(n, self.backoff_s, self.backoff_cap_s)
+        delay = (0.0 if cls in ("preempted", "serving-crash")
+                 else _backoff_s(n, self.backoff_s, self.backoff_cap_s))
         return SupervisorDecision("restart", cls, delay_s=delay, num_processes=new_procs)
 
 

@@ -153,7 +153,7 @@ class DisaggServingEngine(ServingEngine):
 
     def __init__(self, model, config=None, *, disagg=None, devices=None,
                  forward_cached=None, compile_manager=None, telemetry=None,
-                 fault_tolerance=None, chaos=None, tracing=None):
+                 fault_tolerance=None, chaos=None, tracing=None, journal=None):
         from .utils.dataclasses import DisaggConfig
 
         self.disagg_config = disagg if disagg is not None else DisaggConfig()
@@ -168,7 +168,7 @@ class DisaggServingEngine(ServingEngine):
         super().__init__(model, config, forward_cached=forward_cached,
                          compile_manager=compile_manager, telemetry=telemetry,
                          fault_tolerance=fault_tolerance, chaos=chaos,
-                         tracing=tracing)
+                         tracing=tracing, journal=journal)
         dc = self.disagg_config
         # Degradation state: quarantined lanes leave the pool for good; once
         # EVERY lane is gone the engine latches degraded and prefills

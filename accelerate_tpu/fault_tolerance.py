@@ -647,7 +647,7 @@ class FaultToleranceManager:
         """Per-step chaos draws. Returns True when the step's metrics must
         be NaN-poisoned (``nonfinite_grad`` — the sentinel sees a divergence;
         model state is untouched so the rollback replay stays bit-equal)."""
-        from .chaos import DEAD_HOST_DEFAULT_EXIT_CODE
+        from .chaos import DEAD_HOST_DEFAULT_EXIT_CODE, flush_injected_log
 
         rank = getattr(self.accelerator, "process_index", 0)
         f = self.chaos.draw("host_heartbeat", tick, unit=rank)
@@ -659,7 +659,11 @@ class FaultToleranceManager:
                 "fault_tolerance: injected dead_host — exiting %d "
                 "(tick %d, rank %d).", code, tick, rank,
             )
-            self.flush_telemetry()
+            # os._exit skips every atexit/finally, so the injector's full
+            # injected log must reach the telemetry stream here or the
+            # post-mortem loses the fault schedule that killed the run.
+            flush_injected_log(
+                self.chaos, getattr(self.accelerator, "telemetry", None))
             os._exit(code)
         poison = False
         f = self.chaos.draw("train_step", tick, unit=rank)

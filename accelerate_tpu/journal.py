@@ -1,0 +1,429 @@
+"""Crash-durable request journal — the serving stack's write-ahead log.
+
+A hard serving-process death (SIGKILL, OOM-137, an injected ``engine_crash``)
+loses the admission queue and every in-flight request unless their lifecycle
+is durable OUTSIDE the process. This module is the request-plane twin of
+fault_tolerance.py's atomic-checkpoint trust boundary: an append-only JSONL
+WAL with per-record checksums whose segment seals reuse the same
+stage → fsync → ``os.replace`` commit discipline, so what the journal says
+happened, happened.
+
+Record format — one record per line, torn-tail tolerant::
+
+    <crc32 hex> <compact json>\\n
+
+A line whose checksum does not match is skipped (and counted); a torn tail
+(a partial final line — the write the crash interrupted) is truncated (and
+counted) so the journal re-opens appendable. The records themselves are
+engine-defined dicts with a ``"t"`` type tag:
+
+- ``admit`` — written at ``submit()``: request id, the caller's
+  ``client_request_id`` idempotency key, prompt tokens, budget, the
+  serialized per-request PRNG key, the deadline BUDGET in monotonic-clock
+  terms (``deadline_s`` + the submit-time ``perf_counter`` — never absolute
+  wall time, so a wall-clock step during an outage cannot expire recovered
+  requests), and a ``t_mono`` stamp;
+- ``bind`` — the param ``weights_version`` the request bound at grant;
+- ``progress`` — one batched record per tick with the tokens each live
+  request emitted (observability; recovery replays from scratch);
+- ``recovered`` — appended by ``ServingEngine.recover()`` per replayed
+  in-flight request, so ``attempt`` accounting survives repeated crashes;
+- ``terminal`` — the finished row (status, full padded token row, latency
+  stats). Self-contained on purpose: compaction can drop a finished
+  request's admit/bind/progress records while the terminal row keeps
+  serving duplicate-``submit`` dedupe and crash-restart cached replies.
+
+Durability knobs (``ServingConfig.journal_fsync``):
+
+- ``every_record`` — flush + fsync after every append (no admitted request
+  is ever lost; highest overhead);
+- ``every_tick`` — buffered appends, one flush + fsync per engine tick
+  (loses at most one tick on a crash; the default);
+- ``os`` — flush to the OS page cache per tick, never fsync (survives a
+  process crash, not a host power loss).
+
+Segments rotate every ``segment_records`` appends: the active segment is
+``wal_NNNNN.jsonl.open`` and sealing is fsync → ``os.replace`` to
+``wal_NNNNN.jsonl`` → directory fsync. Compaction merges the sealed
+segments into one, retiring the working records of terminally-statused
+requests (their terminal rows survive, see above) while every unfinished
+request's records are preserved verbatim.
+
+Off by default everywhere: no journal exists unless you construct one (or
+set ``ServingConfig.journal_dir``) — the serving hot path then holds one
+``is None`` check per site. Deterministic chaos hooks: an attached
+:class:`~accelerate_tpu.chaos.FaultInjector` is drawn at ``journal_append``
+(``torn_write``: the append is torn mid-line, then re-written on a fresh
+line — the checksum-skip path gets coverage while durability holds) and at
+``journal_compact`` (``torn_write``: the compaction aborts cleanly, staging
+removed, sealed segments untouched).
+
+Usage::
+
+    from accelerate_tpu import RequestJournal, ServingConfig, ServingEngine
+
+    engine = ServingEngine(model, ServingConfig(journal_dir="wal/"))
+    rid = engine.submit(prompt, client_request_id="req-0")
+    ...                                   # process dies mid-flight
+    engine = ServingEngine(model, ServingConfig(journal_dir="wal/"))
+    engine.recover()                      # completed -> cached rows,
+    ...                                   # in-flight -> bit-equal replay
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _log_ok() -> bool:
+    """The repo logger needs accelerate state; the journal must also work
+    standalone (no Accelerator), where these logs are just skipped."""
+    from .state import PartialState
+
+    return bool(PartialState._shared_state)
+
+__all__ = ["RequestJournal", "JOURNAL_FSYNC_POLICIES"]
+
+#: Legal ``fsync`` policies, strongest first.
+JOURNAL_FSYNC_POLICIES = ("every_record", "every_tick", "os")
+
+_PREFIX = "wal_"
+_SEALED = ".jsonl"
+_OPEN = ".jsonl.open"
+_COMPACT_STAGING = "compact.jsonl.tmp"
+
+
+def _fsync_helpers():
+    """The atomic-commit primitives are fault_tolerance.py's — ONE
+    implementation of "durably on disk" for checkpoints and the journal."""
+    from .fault_tolerance import _fsync_dir, _fsync_file
+
+    return _fsync_file, _fsync_dir
+
+
+def _encode(rec: dict) -> str:
+    data = json.dumps(rec, separators=(",", ":"))
+    return f"{zlib.crc32(data.encode('utf-8')):08x} {data}\n"
+
+
+def _decode(line: str) -> Optional[dict]:
+    """One checksummed line -> record dict, or None if torn/corrupt."""
+    parts = line.split(" ", 1)
+    if len(parts) != 2 or len(parts[0]) != 8:
+        return None
+    try:
+        crc = int(parts[0], 16)
+    except ValueError:
+        return None
+    if zlib.crc32(parts[1].encode("utf-8")) != crc:
+        return None
+    try:
+        rec = json.loads(parts[1])
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+class RequestJournal:
+    """Append-only, checksummed, torn-tail-tolerant request WAL.
+
+    ``fsync`` is one of :data:`JOURNAL_FSYNC_POLICIES`; ``segment_records``
+    bounds the active segment before rotation (a seal + a compaction pass
+    over the sealed set). ``chaos`` is an optional
+    :class:`~accelerate_tpu.chaos.FaultInjector` (the owning engine attaches
+    its own so one seeded schedule covers serving and journal faults
+    together)."""
+
+    def __init__(self, journal_dir: str, *, fsync: str = "every_tick",
+                 segment_records: int = 512, chaos=None):
+        if fsync not in JOURNAL_FSYNC_POLICIES:
+            raise ValueError(
+                f"journal fsync policy must be one of "
+                f"{JOURNAL_FSYNC_POLICIES}, got {fsync!r}"
+            )
+        if int(segment_records) < 1:
+            raise ValueError(
+                f"segment_records must be >= 1, got {segment_records}"
+            )
+        self.dir = str(journal_dir)
+        self.fsync = fsync
+        self.segment_records = int(segment_records)
+        self.chaos = chaos
+        os.makedirs(self.dir, exist_ok=True)
+        self._fh = None
+        self._open_path: Optional[str] = None
+        self._open_records = 0
+        self._next_index = 1 + max(
+            [i for i, _ in self._segments()], default=-1)
+        self._dirty = False
+        # Retirement state: rids with a journaled terminal row. Rebuilt by
+        # replay() when this object is opened over an existing directory.
+        self._retired: set[int] = set()
+        self._admitted: set[int] = set()
+        self._c = {
+            "appends": 0, "bytes_written": 0, "syncs": 0, "rotations": 0,
+            "compactions": 0, "compact_aborts": 0, "records_retired": 0,
+            "torn_writes": 0, "torn_tails": 0, "corrupt_skipped": 0,
+        }
+
+    # -- segment bookkeeping ----------------------------------------------
+
+    def _segments(self) -> list[tuple[int, str]]:
+        """Every journal segment on disk as sorted ``(index, path)`` —
+        sealed and crash-orphaned ``.open`` files alike (an index exists as
+        exactly one of the two)."""
+        out = []
+        for fn in os.listdir(self.dir):
+            if not fn.startswith(_PREFIX):
+                continue
+            if fn.endswith(_OPEN):
+                idx = fn[len(_PREFIX):-len(_OPEN)]
+            elif fn.endswith(_SEALED):
+                idx = fn[len(_PREFIX):-len(_SEALED)]
+            else:
+                continue
+            try:
+                out.append((int(idx), os.path.join(self.dir, fn)))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _ensure_open(self) -> None:
+        if self._fh is None:
+            name = f"{_PREFIX}{self._next_index:05d}{_OPEN}"
+            self._next_index += 1
+            self._open_path = os.path.join(self.dir, name)
+            self._fh = open(self._open_path, "a", encoding="utf-8")
+            self._open_records = 0
+
+    def _seal(self) -> None:
+        """Commit the active segment: fsync its bytes, then atomically
+        rename away the ``.open`` suffix, then fsync the directory — the
+        same stage→fsync→replace discipline as a checkpoint commit."""
+        if self._fh is None:
+            return
+        _fsync_file, _fsync_dir = _fsync_helpers()
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._fh = None
+        self._dirty = False
+        sealed = self._open_path[: -len(_OPEN)] + _SEALED
+        os.replace(self._open_path, sealed)
+        _fsync_dir(self.dir)
+        self._open_path = None
+        self._c["rotations"] += 1
+
+    # -- the append path ---------------------------------------------------
+
+    def append(self, rec: dict, *, tick: int = 0, unit: int = 0) -> None:
+        """Durably (per policy) append one record. ``tick``/``unit`` key
+        the deterministic chaos draw at ``journal_append``."""
+        self._ensure_open()
+        line = _encode(rec)
+        if self.chaos is not None:
+            fault = self.chaos.draw("journal_append", tick, unit=unit)
+            if fault is not None and fault.kind == "torn_write":
+                # A torn append: half the line lands, newline-terminated
+                # garbage (the checksum-skip path on replay). The journal
+                # detects the short write and re-writes the record whole —
+                # durability holds, the corruption machinery gets exercised.
+                frag = line[: max(1, len(line) // 2)].rstrip("\n") + "\n"
+                self._fh.write(frag)
+                self._c["torn_writes"] += 1
+                self._c["bytes_written"] += len(frag)
+        self._fh.write(line)
+        self._c["appends"] += 1
+        self._c["bytes_written"] += len(line)
+        rid = rec.get("rid")
+        t = rec.get("t")
+        if rid is not None:
+            if t == "terminal":
+                self._retired.add(int(rid))
+            elif t == "admit":
+                self._admitted.add(int(rid))
+        if self.fsync == "every_record":
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._c["syncs"] += 1
+        else:
+            self._dirty = True
+        self._open_records += 1
+        if self._open_records >= self.segment_records:
+            self._seal()
+            self.compact(tick=tick)
+
+    def tick_flush(self) -> None:
+        """The per-tick durability point: flush buffered appends, fsync
+        under ``every_tick`` (the ``os`` policy stops at the page cache)."""
+        if self._fh is None or not self._dirty:
+            return
+        self._fh.flush()
+        if self.fsync == "every_tick":
+            os.fsync(self._fh.fileno())
+            self._c["syncs"] += 1
+        self._dirty = False
+
+    # -- replay + compaction ----------------------------------------------
+
+    def _read_segment(self, path: str, repair: bool = False) -> list[dict]:
+        """Records from one segment, skipping corrupt lines. A torn tail
+        (no trailing newline) is counted and — with ``repair`` — truncated
+        in place so the file is clean for whatever appends next."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        if not raw:
+            return []
+        keep = len(raw)
+        if not raw.endswith(b"\n"):
+            self._c["torn_tails"] += 1
+            nl = raw.rfind(b"\n")
+            keep = nl + 1 if nl >= 0 else 0
+            if repair:
+                with open(path, "rb+") as f:
+                    f.truncate(keep)
+        out = []
+        for line in raw[:keep].decode("utf-8", errors="replace").splitlines():
+            if not line:
+                continue
+            rec = _decode(line)
+            if rec is None:
+                self._c["corrupt_skipped"] += 1
+                continue
+            out.append(rec)
+        return out
+
+    def replay(self) -> tuple[list[dict], dict]:
+        """Read every record on disk, in append order, repairing torn tails
+        as it goes. Returns ``(records, scan)`` where ``scan`` counts what
+        recovery needs to report: segments read, records kept, torn tails
+        truncated, corrupt lines skipped. Also rebuilds the retirement sets
+        so compaction works on a freshly re-opened directory."""
+        torn0 = self._c["torn_tails"]
+        corrupt0 = self._c["corrupt_skipped"]
+        records: list[dict] = []
+        segs = self._segments()
+        for _, path in segs:
+            records.extend(self._read_segment(path, repair=True))
+        for rec in records:
+            rid = rec.get("rid")
+            if rid is None:
+                continue
+            if rec.get("t") == "terminal":
+                self._retired.add(int(rid))
+            elif rec.get("t") == "admit":
+                self._admitted.add(int(rid))
+        return records, {
+            "segments": len(segs),
+            "records": len(records),
+            "torn_tails": self._c["torn_tails"] - torn0,
+            "corrupt_skipped": self._c["corrupt_skipped"] - corrupt0,
+        }
+
+    def compact(self, *, tick: int = 0) -> int:
+        """Merge the SEALED segments into one, dropping the admit / bind /
+        progress / recovered records of terminally-statused requests (their
+        self-contained terminal rows are kept — they back duplicate-submit
+        dedupe and crash-restart cached replies). Unfinished requests'
+        records pass through verbatim. Returns the number of records
+        retired; 0 when there is nothing to do or the (chaos-injected)
+        staging write tears — the sealed segments are untouched either
+        way."""
+        sealed = [(i, p) for i, p in self._segments() if p.endswith(_SEALED)]
+        if len(sealed) < 2 and not self._retired:
+            return 0
+        if not sealed:
+            return 0
+        _fsync_file, _fsync_dir = _fsync_helpers()
+        kept: list[dict] = []
+        dropped = 0
+        for _, path in sealed:
+            for rec in self._read_segment(path):
+                rid = rec.get("rid")
+                t = rec.get("t")
+                if t == "progress":
+                    toks = {k: v for k, v in (rec.get("toks") or {}).items()
+                            if int(k) not in self._retired}
+                    if not toks:
+                        dropped += 1
+                        continue
+                    if len(toks) != len(rec.get("toks") or {}):
+                        rec = dict(rec, toks=toks)
+                elif (rid is not None and int(rid) in self._retired
+                        and t != "terminal"):
+                    dropped += 1
+                    continue
+                kept.append(rec)
+        staging = os.path.join(self.dir, _COMPACT_STAGING)
+        torn = None
+        if self.chaos is not None:
+            torn = self.chaos.draw("journal_compact", tick)
+        try:
+            with open(staging, "w", encoding="utf-8") as f:
+                for rec in kept:
+                    f.write(_encode(rec))
+                f.flush()
+                if torn is not None and torn.kind == "torn_write":
+                    raise OSError("injected torn_write during compaction")
+                os.fsync(f.fileno())
+        except OSError as e:
+            # Abort cleanly: staging removed, every sealed segment intact.
+            try:
+                os.remove(staging)
+            except OSError:
+                pass
+            self._c["compact_aborts"] += 1
+            if _log_ok():
+                logger.warning("journal: compaction aborted (%s) — sealed "
+                               "segments untouched", e)
+            return 0
+        # Commit: the merged segment atomically replaces the FIRST sealed
+        # segment, then the rest are unlinked. A crash between the two
+        # steps leaves duplicate (idempotently re-read) records, never a
+        # missing one.
+        os.replace(staging, sealed[0][1])
+        for _, path in sealed[1:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        _fsync_dir(self.dir)
+        self._c["compactions"] += 1
+        self._c["records_retired"] += dropped
+        return dropped
+
+    # -- lifecycle / reporting --------------------------------------------
+
+    def close(self) -> None:
+        """Clean shutdown: seal the active segment (full fsync + atomic
+        rename) regardless of the append-path fsync policy."""
+        if self._fh is not None:
+            self._seal()
+
+    def stats(self) -> dict:
+        """The journal telemetry block (embedded under
+        ``ServingEngine.stats()["journal"]``, pinned by
+        tests/test_schemas.py)."""
+        return {
+            "dir": self.dir,
+            "fsync": self.fsync,
+            "appends": self._c["appends"],
+            "bytes_written": self._c["bytes_written"],
+            "syncs": self._c["syncs"],
+            "rotations": self._c["rotations"],
+            "compactions": self._c["compactions"],
+            "compact_aborts": self._c["compact_aborts"],
+            "records_retired": self._c["records_retired"],
+            "torn_writes": self._c["torn_writes"],
+            "torn_tails": self._c["torn_tails"],
+            "corrupt_skipped": self._c["corrupt_skipped"],
+            "pending": len(self._admitted - self._retired),
+            "retired": len(self._retired),
+        }

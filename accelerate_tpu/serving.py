@@ -84,6 +84,7 @@ Usage::
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from collections import deque
 from typing import Any, Callable, NamedTuple, Optional
@@ -102,7 +103,7 @@ from .generation import (
     sample_logits,
 )
 from .logging import get_logger
-from .utils.constants import PREEMPTION_EXIT_CODE
+from .utils.constants import PREEMPTION_EXIT_CODE, SERVING_CRASH_EXIT_CODE
 
 logger = get_logger(__name__)
 
@@ -385,6 +386,7 @@ class _Request:
         "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
         "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
         "deadline", "retries", "status", "weights_version", "canary", "layout",
+        "client_request_id", "recoveries",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -408,6 +410,8 @@ class _Request:
         self.weights_version = None   # param version bound at first grant
         self.canary = False           # admitted inside a canary window
         self.layout = None            # topology generation bound at grant
+        self.client_request_id = None  # caller's idempotency key (journal)
+        self.recoveries = 0           # crash-restart replays (no retry spend)
 
     def reset_for_retry(self) -> None:
         """Back to freshly-queued: prompt, budget, rng, deadline, the
@@ -446,7 +450,7 @@ class ServingEngine:
 
     def __init__(self, model, config=None, *, forward_cached: Optional[Callable] = None,
                  compile_manager=None, telemetry=None, fault_tolerance=None,
-                 chaos=None, tracing=None):
+                 chaos=None, tracing=None, journal=None):
         from .utils.dataclasses import ServingConfig
 
         self.config = config if config is not None else ServingConfig()
@@ -460,6 +464,24 @@ class ServingEngine:
         # ``is None`` check, same zero-cost contract as telemetry/chaos.
         self.tracing = tracing if tracing is not None else getattr(
             telemetry, "tracing", None)
+        # Crash-durable request journal (journal.py): ``journal=`` takes a
+        # RequestJournal or a directory path; ``ServingConfig.journal_dir``
+        # is the config-only spelling. None (the default everywhere) keeps
+        # the WAL fully off — one ``is None`` check per hot-path site.
+        jr = journal if journal is not None else self.config.journal_dir
+        if jr is not None and isinstance(jr, (str, os.PathLike)):
+            from .journal import RequestJournal
+
+            jr = RequestJournal(
+                str(jr), fsync=self.config.journal_fsync,
+                segment_records=self.config.journal_segment_records,
+            )
+        self._journal = jr
+        self._journal_tokens: dict[int, list[int]] = {}
+        self._client_ids: dict[str, int] = {}
+        self._cached_rows: dict[int, dict] = {}
+        self._jstats = {"recovered_inflight": 0, "recovered_terminal": 0,
+                        "deduped": 0}
         self.chaos = chaos
         name = type(model.module).__name__
         if forward_cached is not None:
@@ -604,12 +626,18 @@ class ServingEngine:
         self._chaos = injector
         if injector is not None and self.tracing is not None:
             self.tracing.attach_chaos(injector)
+        # The journal draws its torn-write faults from the same injector so
+        # one seeded schedule covers serving + journal faults together.
+        jr = getattr(self, "_journal", None)
+        if jr is not None:
+            jr.chaos = injector
 
     # -- request lifecycle -------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                rng: Optional[jax.Array] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               client_request_id: Optional[str] = None) -> int:
         """Queue one request; returns its id. ``prompt`` is a 1-D token id
         sequence; ``rng`` seeds this request's private sampling stream
         (default ``jax.random.key(0)`` — generate()'s default);
@@ -617,12 +645,28 @@ class ServingEngine:
         request (seconds from submission — miss it and the request finishes
         ``timeout``).
 
+        ``client_request_id`` is the caller's idempotency key (any string,
+        unique per logical request). A duplicate submit with a seen key
+        DEDUPES instead of re-running: it returns the original id, and if
+        that request already finished, its cached terminal row is re-emitted
+        to ``poll()`` — exactly-once completion at the API, across retries
+        AND (with a journal attached) across crash-restart recovery.
+
         Admission control: with ``max_queue_depth`` set and the queue full,
         ``overload_policy`` decides — ``reject`` finishes THIS request
         ``shed`` immediately, ``shed_oldest`` drops the oldest queued
         request instead, ``block`` ticks the engine until a queue slot
         frees (bounded by the hang guard). Every path still returns an id
         whose result lands in ``poll()``."""
+        cid = str(client_request_id) if client_request_id is not None else None
+        if cid is not None:
+            known = self._client_ids.get(cid)
+            if known is not None:
+                self._jstats["deduped"] += 1
+                row = self._cached_rows.get(known)
+                if row is not None:  # finished: re-emit the cached row
+                    self._finished.append(dict(row))
+                return known
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("empty prompt")
@@ -638,6 +682,9 @@ class ServingEngine:
             )
         req = _Request(next(self._ids), tokens, budget,
                        rng if rng is not None else jax.random.key(0))
+        req.client_request_id = cid
+        if cid is not None:
+            self._client_ids[cid] = req.id
         dl = deadline_s if deadline_s is not None else self.config.deadline_s
         if dl is not None:
             if float(dl) <= 0:
@@ -647,6 +694,24 @@ class ServingEngine:
         self._stats["submitted"] += 1
         if self._first_submit_t is None:
             self._first_submit_t = req.submit_t
+        if self._journal is not None:
+            # The WAL admission record: everything a bit-equal replay needs
+            # (prompt + serialized rng + budget) plus the deadline BUDGET in
+            # monotonic-clock terms — never absolute wall time, so a clock
+            # step during an outage cannot expire recovered requests.
+            try:
+                key_data = np.asarray(
+                    jax.random.key_data(req.rng)).reshape(-1).tolist()
+            except Exception:  # raw legacy uint32 key arrays
+                key_data = np.asarray(req.rng).reshape(-1).tolist()
+            self._journal.append({
+                "t": "admit", "rid": req.id, "cid": cid,
+                "tokens": tokens.tolist(), "budget": budget,
+                "rng": key_data,
+                "deadline_s": float(dl) if dl is not None else None,
+                "t_mono": req.submit_t,
+                "weights_version": self._weights_version,
+            }, tick=self._stats["ticks"], unit=req.id)
         if self.tracing is not None:
             self.tracing.request_submitted(
                 req.id, self._stats["ticks"], req.submit_t,
@@ -674,7 +739,8 @@ class ServingEngine:
 
     def poll(self) -> list[dict]:
         """Results finished since the last poll: ``{"id", "status",
-        "tokens", "new_tokens", "ttft_s", "tpot_s", "weights_version"}`` —
+        "tokens", "new_tokens", "ttft_s", "tpot_s", "weights_version",
+        "attempt", "recovered"}`` —
         ``weights_version`` is the param version the request bound at grant
         (``None`` if it was shed before ever being granted a slot) and
         ``tokens`` is the
@@ -683,7 +749,10 @@ class ServingEngine:
         request's explicit terminal state, one of
         :data:`REQUEST_STATUSES` (``ok`` | ``timeout`` | ``shed`` |
         ``failed``) — EVERY submitted id eventually shows up here with
-        one."""
+        one. ``attempt`` counts executions (1 + retries + crash-restart
+        recoveries) and ``recovered`` flags rows that crossed a crash: a
+        cached pre-crash completion replayed from the journal, or an
+        in-flight request re-run bit-equal after ``recover()``."""
         out = list(self._finished)
         self._finished.clear()
         return out
@@ -761,6 +830,26 @@ class ServingEngine:
         return self._progress_marker()
 
     def _end_tick(self, snap: tuple) -> None:
+        if self._journal is not None:
+            if self._journal_tokens:
+                # One batched progress record per tick (observability — a
+                # recovery replays from scratch), then the tick's durability
+                # point per the fsync policy.
+                self._journal.append(
+                    {"t": "progress", "tick": self._stats["ticks"],
+                     "t_mono": time.perf_counter(),
+                     "toks": self._journal_tokens},
+                    tick=self._stats["ticks"])
+                self._journal_tokens = {}
+            self._journal.tick_flush()
+        if self._chaos is not None:
+            # The process-death draw sits AFTER the journal flush on
+            # purpose: what the fsync policy promises durable IS durable
+            # when the crash lands — the exact contract the game-day smoke
+            # verifies.
+            fault = self._chaos.draw("engine_crash", self._stats["ticks"])
+            if fault is not None and fault.kind == "crash":
+                self._hard_crash(fault)
         self._stats["ticks"] += 1
         if self.pending and self._progress_marker() == snap:
             self._idle_ticks += 1
@@ -816,6 +905,12 @@ class ServingEngine:
             # the retry replays bit-equal.
             req.weights_version = self._route_version()
             req.canary = self._canary is not None
+            if self._journal is not None:
+                self._journal.append(
+                    {"t": "bind", "rid": req.id,
+                     "weights_version": req.weights_version,
+                     "t_mono": req.admit_t},
+                    tick=self._stats["ticks"], unit=req.id)
         self._stats["slot_allocs"] += 1
         if slot in self._used_slots:
             self._stats["slot_reuses"] += 1
@@ -869,6 +964,9 @@ class ServingEngine:
             self._prefilling.remove(req)
             req.first_token_t = time.perf_counter()
             req.out.append(int(tok))  # small host fetch — the TTFT moment
+            if self._journal is not None:
+                self._journal_tokens.setdefault(req.id, []).append(
+                    req.out[-1])
             if tr is not None:
                 tr.first_token(req.id, self._stats["ticks"],
                                req.first_token_t)
@@ -948,6 +1046,9 @@ class ServingEngine:
                     self._on_poisoned_slot(slot, req)
                     continue
                 req.out.append(int(tok_np[slot]))
+                if self._journal is not None:
+                    self._journal_tokens.setdefault(req.id, []).append(
+                        req.out[-1])
                 if bool(done_np[slot]):
                     del self._decoding[slot]
                     self._retire(req)
@@ -1013,11 +1114,31 @@ class ServingEngine:
             self._cohorts[req.weights_version]["events"].append({
                 "status": status, "ttft_s": ttft, "tpot_s": tpot,
             })
-        self._finished.append({
+        attempt = 1 + req.retries + req.recoveries
+        result = {
             "id": req.id, "status": status, "tokens": row, "new_tokens": n_new,
             "ttft_s": ttft, "tpot_s": tpot,
             "weights_version": req.weights_version,
-        })
+            "attempt": attempt, "recovered": req.recoveries > 0,
+        }
+        self._finished.append(result)
+        if req.client_request_id is not None:
+            # Exactly-once at the API: a duplicate submit with this key
+            # re-emits the cached row instead of re-running the request.
+            self._cached_rows[req.id] = result
+        if self._journal is not None:
+            self._journal_tokens.pop(req.id, None)
+            # Terminal rows are self-contained (the full padded token row
+            # rides along) so compaction can retire the request's working
+            # records while dedupe + crash-restart cached replies survive.
+            self._journal.append({
+                "t": "terminal", "rid": req.id,
+                "cid": req.client_request_id, "status": status,
+                "row": row.tolist(), "new_tokens": n_new,
+                "ttft_s": ttft, "tpot_s": tpot,
+                "weights_version": req.weights_version,
+                "attempt": attempt, "t_mono": req.done_t,
+            }, tick=self._stats["ticks"], unit=req.id)
         if len(self._params_by_version) > 1:
             self._gc_versions()
         if self.tracing is not None:
@@ -1159,6 +1280,209 @@ class ServingEngine:
                 )
             self._poison_op = jax.jit(poison, donate_argnums=(0,))
         self._cache = self._poison_op(self._cache, np.int32(slot))
+
+    # -- crash durability (the journal.py write-ahead log) -----------------
+
+    @property
+    def journal(self):
+        """The attached :class:`~accelerate_tpu.journal.RequestJournal`
+        (or None — journaling is off by default)."""
+        return self._journal
+
+    def _hard_crash(self, fault) -> None:
+        """An injected ``engine_crash``: die like a real serving-process
+        death — no drain, no journal seal (what the fsync policy promised
+        durable is the contract under test) — after flushing telemetry and
+        the injector's log so the post-mortem schedule is never torn."""
+        from .chaos import flush_injected_log
+
+        code = int((fault.extra or {}).get(
+            "exit_code", SERVING_CRASH_EXIT_CODE))
+        if _log_ok():
+            logger.error(
+                "serving: injected engine_crash — exiting %d (tick %d); "
+                "%d request(s) in flight%s", code, self._stats["ticks"],
+                self.pending,
+                "" if self._journal is None else
+                " — recover() replays them from the journal",
+            )
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_event(
+                    "serving_engine_crash", tick=self._stats["ticks"],
+                    exit_code=code, pending=self.pending,
+                    journaled=self._journal is not None,
+                )
+            except Exception:  # pragma: no cover - dying anyway
+                pass
+        flush_injected_log(self._chaos, self.telemetry)
+        os._exit(code)
+
+    def recover(self, journal_dir: Optional[str] = None) -> dict:
+        """Rebuild request state from the write-ahead journal after a
+        process death. Call on a freshly constructed (and ideally warmed)
+        engine over the SAME journal directory — via the attached journal,
+        or ``journal_dir`` when the engine was built without one.
+
+        - Requests with a journaled terminal status return their CACHED
+          rows through ``poll()`` (flagged ``recovered``) — they are never
+          re-executed, and their ``client_request_id`` keys keep deduping
+          duplicate submits: exactly-once completion across the crash.
+        - In-flight requests re-enter the queue in admission order and
+          replay from their original prompt + rng via the same
+          ``reset_for_retry`` idempotency contract — bit-equal output under
+          the same weights version — WITHOUT spending a ``max_retries``
+          attempt (``recoveries``, not ``retries``; their ``poll()`` rows
+          carry ``recovered: True`` and the bumped ``attempt``).
+        - Remaining deadline budget is re-anchored on THIS process's
+          monotonic clock: the journal stores ``deadline_s`` plus
+          ``t_mono`` stamps, so elapsed pre-crash runtime is charged but a
+          wall-clock step during the outage is not.
+
+        The decode executable census is untouched — recovery is pure host
+        bookkeeping feeding the existing admission path. Returns a summary
+        dict (recovered counts + journal scan stats)."""
+        if self._journal is None and journal_dir is not None:
+            from .journal import RequestJournal
+
+            self._journal = RequestJournal(
+                str(journal_dir), fsync=self.config.journal_fsync,
+                segment_records=self.config.journal_segment_records,
+            )
+            self._journal.chaos = self._chaos
+        if self._journal is None:
+            raise ValueError(
+                "recover() needs a journal: pass journal_dir=, set "
+                "ServingConfig.journal_dir, or construct the engine with "
+                "journal=."
+            )
+        t_start = time.perf_counter()
+        tr = self.tracing
+        span = (tr.begin("serving", "recover", self._stats["ticks"])
+                if tr is not None else None)
+        records, scan = self._journal.replay()
+        admits: dict[int, dict] = {}
+        terminals: dict[int, dict] = {}
+        binds: dict[int, int] = {}
+        recovers: dict[int, int] = {}
+        last_mono = None
+        for rec in records:
+            tm = rec.get("t_mono")
+            if tm is not None:
+                last_mono = tm if last_mono is None else max(last_mono, tm)
+            rid = rec.get("rid")
+            t = rec.get("t")
+            if rid is None:
+                continue
+            rid = int(rid)
+            if t == "admit":
+                admits[rid] = rec
+            elif t == "terminal":
+                terminals[rid] = rec
+            elif t == "bind" and rec.get("weights_version") is not None:
+                binds[rid] = int(rec["weights_version"])
+            elif t == "recovered":
+                recovers[rid] = recovers.get(rid, 0) + 1
+        now = time.perf_counter()
+        n_terminal = n_inflight = 0
+        for rid in sorted(admits):
+            a = admits[rid]
+            cid = a.get("cid")
+            trec = terminals.get(rid)
+            if trec is not None:
+                result = {
+                    "id": rid, "status": trec.get("status"),
+                    "tokens": np.asarray(trec.get("row", []), np.int32),
+                    "new_tokens": int(trec.get("new_tokens", 0)),
+                    "ttft_s": trec.get("ttft_s"),
+                    "tpot_s": trec.get("tpot_s"),
+                    "weights_version": trec.get("weights_version"),
+                    "attempt": int(trec.get("attempt", 1)),
+                    "recovered": True,
+                }
+                self._finished.append(result)
+                self._cached_rows[rid] = result
+                if cid is not None:
+                    self._client_ids[str(cid)] = rid
+                n_terminal += 1
+                continue
+            try:
+                rng = jax.random.wrap_key_data(
+                    jnp.asarray(a["rng"], jnp.uint32))
+            except Exception:
+                rng = jax.random.key(0)
+            req = _Request(rid, np.asarray(a["tokens"], np.int32),
+                           int(a["budget"]), rng)
+            req.client_request_id = str(cid) if cid is not None else None
+            # Crash replays spend `recoveries`, never the retry budget; the
+            # journaled recover markers make the count survive repeated
+            # crashes.
+            req.recoveries = recovers.get(rid, 0) + 1
+            dl = a.get("deadline_s")
+            if dl is not None:
+                elapsed = 0.0
+                if last_mono is not None and a.get("t_mono") is not None:
+                    # Pre-crash runtime in the DEAD process's own monotonic
+                    # epoch — comparable stamps by construction, immune to
+                    # any wall-clock step during the outage.
+                    elapsed = max(0.0, float(last_mono) - float(a["t_mono"]))
+                req.deadline = now + max(0.0, float(dl) - elapsed)
+                self._has_deadlines = True
+            v = binds.get(rid)
+            if v is not None and v in self._params_by_version:
+                # _grant keeps an existing binding, so the replay decodes
+                # under the SAME weights — bit-equal. A version that no
+                # longer exists in this process rebinds at grant (reported
+                # via the row's weights_version).
+                req.weights_version = v
+            if req.client_request_id is not None:
+                self._client_ids[req.client_request_id] = rid
+            self._queue.append(req)
+            self._journal.append(
+                {"t": "recovered", "rid": rid, "tick": self._stats["ticks"],
+                 "t_mono": now},
+                tick=self._stats["ticks"], unit=rid)
+            self._stats["submitted"] += 1
+            n_inflight += 1
+            if tr is not None:
+                tr.request_retry(rid, self._stats["ticks"],
+                                 reason="recovered",
+                                 attempt=req.retries + req.recoveries)
+        if admits:
+            # Fresh ids must never collide with journaled ones.
+            self._ids = itertools.count(max(admits) + 1)
+        self._journal.tick_flush()
+        self._jstats["recovered_inflight"] += n_inflight
+        self._jstats["recovered_terminal"] += n_terminal
+        summary = {
+            "recovered_inflight": n_inflight,
+            "recovered_terminal": n_terminal,
+            "records": scan["records"],
+            "segments": scan["segments"],
+            "torn_tails": scan["torn_tails"],
+            "corrupt_skipped": scan["corrupt_skipped"],
+            "elapsed_s": round(time.perf_counter() - t_start, 6),
+        }
+        if span is not None:
+            tr.end(span, self._stats["ticks"],
+                   recovered_inflight=n_inflight,
+                   recovered_terminal=n_terminal,
+                   torn_tails=scan["torn_tails"],
+                   corrupt_skipped=scan["corrupt_skipped"])
+        if self.telemetry is not None:
+            try:
+                self.telemetry.record_event("serving_recovered", **summary)
+            except Exception:
+                pass
+        if _log_ok():
+            logger.info(
+                "serving: recovered from journal %s — %d in-flight request(s) "
+                "re-queued for bit-equal replay, %d cached terminal row(s) "
+                "(%d torn tail(s) truncated, %d corrupt record(s) skipped)",
+                self._journal.dir, n_inflight, n_terminal,
+                scan["torn_tails"], scan["corrupt_skipped"],
+            )
+        return summary
 
     # -- weight publication (the publish.py hot-swap seam) -----------------
 
@@ -1466,7 +1790,13 @@ class ServingEngine:
         keep their (now fully warmed) sizes."""
         prompt_len = min(sum(self.ladder), self.t_max - 2)
         prompt = np.ones((prompt_len,), np.int32)
-        self.run([prompt], max_new_tokens=2)
+        # The synthetic request must not reach the WAL: a journaled warmup
+        # row would replay as a phantom request at the next recover().
+        jr, self._journal = self._journal, None
+        try:
+            self.run([prompt], max_new_tokens=2)
+        finally:
+            self._journal = jr
         self.reset_metrics()
 
     def reset_metrics(self) -> None:
@@ -1488,6 +1818,8 @@ class ServingEngine:
         self._window.clear()
         self._queue_depth_window.clear()
         self._finished.clear()
+        for k in self._jstats:
+            self._jstats[k] = 0
         if self.tracing is not None:
             # The trace restarts with the metrics: warmup spans would
             # otherwise pollute explain()/the tick-domain replay invariant.
@@ -1559,8 +1891,19 @@ class ServingEngine:
             "canary": self.canary_status(),
             "window": self.window_stats(),
             "faults": self.fault_stats(),
+            "journal": self.journal_stats(),
         }
         return out
+
+    def journal_stats(self) -> Optional[dict]:
+        """The ``journal`` telemetry block: WAL counters (appends, syncs,
+        rotations, compactions, torn writes/tails, corrupt skips) plus this
+        engine's recovery/dedupe counts — or None with journaling off."""
+        if self._journal is None:
+            return None
+        js = self._journal.stats()
+        js.update(self._jstats)
+        return js
 
     def fault_stats(self) -> dict:
         """The ``faults`` telemetry block: terminal-status counters plus the
@@ -1581,9 +1924,12 @@ class ServingEngine:
                 logger.warning_once(f"serving: telemetry summary failed: {e}")
 
     def close(self) -> None:
-        """Flush the serving summary into the telemetry stream (no device
-        state to tear down — caches are plain donated arrays)."""
+        """Flush the serving summary into the telemetry stream and seal the
+        journal's active segment (no device state to tear down — caches are
+        plain donated arrays)."""
         self._push_telemetry_summary()
+        if self._journal is not None:
+            self._journal.close()
 
 
 # ---------------------------------------------------------------------------

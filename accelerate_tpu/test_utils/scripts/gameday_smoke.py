@@ -1,0 +1,442 @@
+"""`make gameday-smoke`: the full-stack crash game day.
+
+Acceptance shape of the crash-durability pillar (journal.py + serving.py
+recover() + chaos.py engine_crash + commands/launch.py supervisor) composed
+with the REST of the serving stack — a train gang committing verified
+checkpoints, a WeightPublisher, an autoscale-attached disagg engine, and
+request tracing — on the 8-device virtual CPU mesh:
+
+1. A child process trains (committing a verified checkpoint) while its
+   journaled disagg engine drains a deterministic Poisson trace with an
+   AutoscaleController polling and a TraceRecorder attached. A seeded
+   chaos schedule tears one journal append mid-line and then injects
+   ``engine_crash`` mid-trace: the child dies HARD through ``os._exit``
+   (rc 78) with requests queued, in flight, and already completed.
+2. The parent plays launch supervisor: ``classify_exit(78)`` reads
+   ``serving-crash`` and ``GangSupervisor.decide`` orders a ZERO-backoff
+   relaunch. The crashed child's telemetry JSONL already holds the
+   ``serving_engine_crash`` event and the injector's full
+   ``chaos_injected_log`` — flushed before the exit.
+3. The relaunched child recovers from the journal: every pre-crash
+   completion surfaces as a cached ``recovered`` row WITHOUT re-executing
+   (executions after the crash == admitted - cached), duplicate submits
+   with the original ``client_request_id`` keys dedupe, the torn append
+   is skipped-with-count, and every in-flight request replays to an
+   explicit terminal status.
+4. Exactly-once + bit-equality: every request in the trace ends ``ok``
+   exactly once across the crash, with token rows bit-equal to an
+   uninterrupted reference run of the same trace; decode stays ONE
+   executable with 0 steady recompiles in every child.
+5. The stack stays composed after recovery: the WeightPublisher promotes
+   the pre-crash checkpoint through a canary window on filler traffic in
+   BOTH the reference and the recovered child.
+6. The whole game day replays bit-identically: a second crash+recover
+   round under the same seed produces the same rows, fault log, recovery
+   summary, and publish decision.
+
+The child processes are this same file with ``--mode=ref|crash|resume``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+N_REQS = 16
+MAX_NEW = 4
+N_SLOTS = 4
+TRAIN_AT = {2: False, 4: False, 6: True}  # tick -> (step, save?)
+CRASH_TICK = 10
+TORN_RID = 2  # this request's admit record is torn mid-line
+CHAOS_SEED = 23
+CHAOS_SCHEDULE = [
+    {"point": "journal_append", "kind": "torn_write", "unit": TORN_RID},
+    {"point": "engine_crash", "kind": "crash", "tick": CRASH_TICK},
+]
+MAX_TICKS = 400
+SERVING_CRASH_RC = 78
+
+
+def _trace(rng):
+    """(arrival_tick, prompt) pairs — Poisson inter-arrivals, prompt
+    lengths within one prefill chunk so the ladder compiles once."""
+    ticks = np.cumsum(1 + rng.poisson(1.0, N_REQS))
+    out = []
+    for t in ticks:
+        n = int(rng.integers(3, 9))
+        out.append((int(t), rng.integers(1, 256, (n,), dtype=np.int32)))
+    return out
+
+
+def child(mode: str, project_dir: str, status_file: str) -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import (
+        Accelerator,
+        AutoscaleConfig,
+        AutoscaleController,
+        DisaggConfig,
+        DisaggServingEngine,
+        FaultInjector,
+        Model,
+        PublishConfig,
+        ServingConfig,
+        WeightPublisher,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils import (
+        FaultToleranceKwargs,
+        ProjectConfiguration,
+        TelemetryKwargs,
+        set_seed,
+    )
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True),
+        kwargs_handlers=[FaultToleranceKwargs(),
+                         TelemetryKwargs(tracing=True, log_every=0)],
+    )
+
+    # -- the train gang: ref and crash commit a verified checkpoint; the
+    # resumed child inherits the crashed run's on-disk commit -------------
+    step = state = batches = None
+    if mode in ("ref", "crash"):
+        train_model = Model.from_flax(module, jax.random.key(1), probe)
+        tokens = rng.integers(0, cfg.vocab_size, (64, 16), dtype=np.int32)
+
+        class DS:
+            def __len__(self):
+                return len(tokens)
+
+            def __getitem__(self, i):
+                return {"input_ids": tokens[i]}
+
+        class Spec:
+            dataset = DS()
+            batch_size = 8
+            sampler = None
+            drop_last = False
+
+        train_model, _, dl = acc.prepare(train_model, optax.adam(1e-3), Spec())
+
+        def loss_fn(params, batch):
+            ids = batch["input_ids"]
+            logits = module.apply({"params": params}, ids[:, :-1])
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.mean(
+                jnp.take_along_axis(logp, ids[:, 1:][..., None], -1))
+
+        step = acc.prepare_train_step(loss_fn)
+        state = acc.train_state
+        batches = iter(dl)
+
+    # -- the serving stack: journaled disagg engine + autoscaler + tracing -
+    serve_model = Model.from_flax(module, jax.random.key(0), probe)
+    chaos = (FaultInjector(seed=CHAOS_SEED, schedule=CHAOS_SCHEDULE)
+             if mode == "crash" else None)
+    engine = DisaggServingEngine(
+        serve_model,
+        ServingConfig(n_slots=N_SLOTS, max_len=64, prefill_chunks=[8],
+                      journal_dir=os.path.join(project_dir, "wal")),
+        disagg=DisaggConfig(n_prefill_lanes=2),
+        telemetry=acc.telemetry, chaos=chaos,
+    )
+    # Hold-only autoscale policy: the loop is composed (sampled every poll)
+    # but a resize mid-game-day would only blur the crash assertions.
+    ctl = AutoscaleController(engine, AutoscaleConfig(
+        poll_ticks=8, queue_depth_high=1e9, queue_depth_low=0.0))
+
+    recovery = None
+    if mode == "resume":
+        recovery = engine.recover()
+
+    arrivals = _trace(np.random.default_rng(7))
+    cids = {}  # client_request_id -> engine id
+    if mode == "resume":
+        # The front-end retries EVERY logical request after the relaunch;
+        # the idempotency keys make that safe — recovered/queued requests
+        # dedupe, finished ones re-emit their cached rows.
+        for i, (_, prompt) in enumerate(arrivals):
+            cids[f"req-{i}"] = engine.submit(
+                prompt, max_new_tokens=MAX_NEW, client_request_id=f"req-{i}")
+        arrivals = []
+
+    rows = {}
+    submitted = len(cids)
+    next_i = submitted
+    for tick in range(MAX_TICKS):
+        while arrivals and arrivals[0][0] <= tick:
+            _, prompt = arrivals.pop(0)
+            cids[f"req-{next_i}"] = engine.submit(
+                prompt, max_new_tokens=MAX_NEW,
+                client_request_id=f"req-{next_i}")
+            next_i += 1
+            submitted += 1
+        if step is not None and tick in TRAIN_AT:
+            state, _ = step(state, next(batches))
+            if TRAIN_AT[tick]:
+                acc.save_state()
+        engine.tick()  # the crash child dies inside this call at CRASH_TICK
+        ctl.poll()
+        for row in engine.poll():
+            rows[row["id"]] = {
+                "id": row["id"], "status": row["status"],
+                "version": row["weights_version"],
+                "attempt": row["attempt"], "recovered": row["recovered"],
+                "tokens": np.asarray(row["tokens"]).tolist(),
+            }
+        if not arrivals and len(rows) >= len(cids):
+            break
+    assert mode != "crash", "the scheduled engine_crash never fired"
+    # Executions in THIS process — before filler traffic, so the parent can
+    # prove cached pre-crash completions were never re-run.
+    executed_main = engine.stats()["requests_completed"]
+
+    # -- the publisher stays live after recovery: canary-promote the
+    # pre-crash checkpoint on filler traffic -------------------------------
+    pub = WeightPublisher(
+        engine,
+        PublishConfig(
+            checkpoint_dir=os.path.join(project_dir, "checkpoints"),
+            canary_fraction=0.5, canary_warmup=1, min_cohort=3,
+            # Loose latency/rate gates: wall-clock noise must never decide.
+            max_ttft_ratio=100.0, max_tpot_ratio=100.0, max_rate_increase=1.0,
+        ),
+        telemetry=acc.telemetry,
+    )
+    filler_rng = np.random.default_rng(13)
+    promoted = None
+    for _ in range(120):
+        engine.submit(filler_rng.integers(1, 256, (6,), dtype=np.int32),
+                      max_new_tokens=2)
+        engine.tick()
+        engine.poll()
+        rec = pub.poll()
+        if rec is not None and rec["action"] == "promoted":
+            promoted = rec["version"]
+            break
+
+    es = engine.stats()
+    status = {
+        "mode": mode,
+        "submitted": submitted,
+        "rows": {cid: rows[rid] for cid, rid in sorted(cids.items())
+                 if rid in rows},
+        "recovery": (None if recovery is None else
+                     {k: v for k, v in recovery.items() if k != "elapsed_s"}),
+        "executed_main": executed_main,
+        "promoted": promoted,
+        # dir is per-round; bytes_written varies with the JSON width of the
+        # wall-clock latency floats inside terminal records.
+        "journal": {k: v for k, v in es["journal"].items()
+                    if k not in ("dir", "bytes_written")},
+        "engine": {
+            "weights_version": es["weights_version"],
+            "steady_recompiles": es["steady_recompiles"],
+            "decode_executables": es["decode_executables"],
+            "sheds": es["faults"]["sheds"],
+            "timeouts": es["faults"]["timeouts"],
+            "failed": es["faults"]["failed"],
+            "retries": es["faults"]["retries"],
+        },
+        "autoscale": {"samples": ctl.stats()["samples"],
+                      "resizes": ctl.stats()["resizes"]},
+        "trace_spans": (acc.telemetry.tracing.stats()["spans"]
+                        if acc.telemetry.tracing is not None else 0),
+    }
+    engine.close()
+    acc.end_training()
+    with open(status_file, "w") as f:
+        json.dump(status, f)
+    print(f"GAMEDAY_CHILD_DONE mode={mode} rows={len(rows)}", flush=True)
+    return 0
+
+
+def _launch(mode: str, project_dir: str, status_file: str):
+    env = {**os.environ}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"), repo_root, os.getcwd()) if p
+    )
+    cmd = [sys.executable, os.path.abspath(__file__), f"--mode={mode}",
+           f"--project-dir={project_dir}", f"--status-file={status_file}"]
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1, env=env,
+    )
+
+
+def _drain(proc, timeout_s: float = 420.0) -> str:
+    out = []
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line:
+            out.append(line)
+            sys.stderr.write(line)
+    if proc.poll() is None:
+        proc.kill()
+        raise AssertionError("child hung past the smoke timeout")
+    out.append(proc.stdout.read() or "")
+    sys.stderr.write(out[-1])
+    return "".join(out)
+
+
+def _telemetry_events(project_dir: str) -> list:
+    path = os.path.join(project_dir, "telemetry", "rank_0.jsonl")
+    events = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "event" in rec:
+                events.append(rec)
+    return events
+
+
+def _gameday_round(tmp: str, name: str) -> dict:
+    """One crash + supervised relaunch over a shared project dir. Returns
+    the resumed child's status plus the crashed child's flushed fault log."""
+    from accelerate_tpu.commands.launch import GangSupervisor, classify_exit
+
+    project_dir = os.path.join(tmp, name)
+    status_file = os.path.join(tmp, f"{name}_status.json")
+
+    proc = _launch("crash", project_dir, status_file)
+    _drain(proc)
+    rc = proc.returncode
+    assert rc == SERVING_CRASH_RC, f"crash child exited {rc}, wanted 78"
+    assert not os.path.exists(status_file), "crash child reached the end?!"
+
+    # The parent IS the launch supervisor here: classify, decide, relaunch.
+    assert classify_exit(rc) == "serving-crash"
+    decision = GangSupervisor(max_restarts=3, backoff_s=5.0).decide(
+        rc, uptime_s=5.0, num_processes=1)
+    assert decision.action == "restart", decision
+    assert decision.delay_s == 0.0, decision  # the journal earns zero backoff
+
+    # The hard exit flushed telemetry + the injector's log BEFORE os._exit.
+    events = _telemetry_events(project_dir)
+    crash_evs = [e for e in events if e["event"] == "serving_engine_crash"]
+    assert len(crash_evs) == 1 and crash_evs[0]["tick"] == CRASH_TICK
+    assert crash_evs[0]["journaled"] is True and crash_evs[0]["pending"] > 0
+    logs = [e for e in events if e["event"] == "chaos_injected_log"]
+    assert len(logs) == 1 and logs[0]["seed"] == CHAOS_SEED
+    fault_log = logs[0]["injected"]
+    assert [f["kind"] for f in fault_log] == ["torn_write", "crash"], fault_log
+    assert fault_log[-1]["tick"] == CRASH_TICK
+
+    proc = _launch("resume", project_dir, status_file)
+    _drain(proc)
+    assert proc.returncode == 0, f"resume child failed rc={proc.returncode}"
+    with open(status_file) as f:
+        status = json.load(f)
+    status["fault_log"] = fault_log
+    return status
+
+
+def main() -> int:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="gameday_smoke_")
+
+    # Uninterrupted reference: same trace, same stack, no chaos.
+    project_dir = os.path.join(tmp, "reference")
+    status_file = os.path.join(tmp, "reference_status.json")
+    proc = _launch("ref", project_dir, status_file)
+    _drain(proc)
+    assert proc.returncode == 0, f"ref child failed rc={proc.returncode}"
+    with open(status_file) as f:
+        ref = json.load(f)
+
+    g1 = _gameday_round(tmp, "gameday1")
+    g2 = _gameday_round(tmp, "gameday2")
+
+    # -- every admitted request reaches an explicit terminal status --------
+    all_cids = {f"req-{i}" for i in range(N_REQS)}
+    for name, s in (("reference", ref), ("gameday", g1)):
+        assert set(s["rows"]) == all_cids, (name, sorted(s["rows"]))
+        assert all(r["status"] == "ok" for r in s["rows"].values()), name
+        e = s["engine"]
+        assert e["sheds"] == e["timeouts"] == e["failed"] == 0, (name, e)
+        assert e["steady_recompiles"] == 0, (name, e)
+        assert e["decode_executables"] == 1, (name, e)
+        assert s["promoted"] == 3, (name, s["promoted"])  # checkpoint step 3
+        assert s["engine"]["weights_version"] == 3, name
+        assert s["autoscale"]["samples"] >= 1, name
+        assert s["autoscale"]["resizes"] == 0, name
+        assert s["trace_spans"] > 0, name
+
+    # -- exactly-once across the crash -------------------------------------
+    rec = g1["recovery"]
+    assert rec["recovered_terminal"] >= 1, rec   # someone finished pre-crash
+    assert rec["recovered_inflight"] >= 1, rec   # someone was mid-flight
+    assert rec["corrupt_skipped"] >= 1, rec      # the torn append was skipped
+    # Pre-crash completions were never re-executed: post-crash executions
+    # account for exactly the admitted-but-unfinished remainder.
+    assert g1["executed_main"] == N_REQS - rec["recovered_terminal"], g1
+    assert g1["journal"]["deduped"] >= rec["recovered_terminal"], g1["journal"]
+    assert g1["engine"]["retries"] == 0, g1["engine"]  # recoveries != retries
+    crossed = [r for r in g1["rows"].values() if r["recovered"]]
+    assert len(crossed) == rec["recovered_terminal"] + rec["recovered_inflight"]
+    # Cached pre-crash completions finished on attempt 1; replayed in-flight
+    # requests carry the crash-restart attempt bump (never a retry spend).
+    replayed = [r for r in crossed if r["attempt"] == 2]
+    assert len(replayed) == rec["recovered_inflight"], crossed
+    assert all(r["attempt"] == 1 for r in crossed if r not in replayed)
+    assert all(r["attempt"] == 1 and not r["recovered"]
+               for r in g1["rows"].values() if r not in crossed)
+
+    # -- bit-equality: crash + replay == the uninterrupted reference -------
+    for cid in sorted(all_cids):
+        assert g1["rows"][cid]["tokens"] == ref["rows"][cid]["tokens"], cid
+        assert g1["rows"][cid]["version"] == ref["rows"][cid]["version"], cid
+
+    # -- the whole game day replays bit-identically ------------------------
+    for key in ("rows", "recovery", "executed_main", "promoted", "journal",
+                "engine", "fault_log", "submitted"):
+        assert g1[key] == g2[key], (
+            f"game-day replay diverged on {key!r}:\n  {g1[key]}\n  {g2[key]}")
+
+    print(
+        "GAMEDAY SMOKE OK — "
+        f"engine_crash at tick {CRASH_TICK} killed the stack with "
+        f"{g1['recovery']['recovered_inflight']} in flight; zero-backoff "
+        "serving-crash relaunch recovered the journal "
+        f"({g1['recovery']['recovered_terminal']} cached, torn append "
+        "skipped), all "
+        f"{N_REQS} requests ok exactly once, rows bit-equal to the "
+        "uninterrupted reference; publisher promoted v3 post-recovery; "
+        "1 decode executable, 0 steady recompiles; replay bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", default=None,
+                        choices=["ref", "crash", "resume"])
+    parser.add_argument("--project-dir", default=None)
+    parser.add_argument("--status-file", default=None)
+    args = parser.parse_args()
+    if args.mode:
+        sys.exit(child(args.mode, args.project_dir, args.status_file))
+    sys.exit(main())

@@ -72,3 +72,8 @@ TRAINING_STALLED_EXIT_CODE = 76
 # (DivergenceError after max_rollbacks). The supervisor refuses to relaunch:
 # the same checkpoint feeds the same divergence, so a restart would thrash.
 POISONED_CHECKPOINT_EXIT_CODE = 77
+# Exit code a hard serving-engine death exits with (the chaos ``engine_crash``
+# default — serving.py). The launch supervisor classifies it "serving-crash"
+# and relaunches with ZERO backoff: the request journal (journal.py) makes a
+# relaunch immediately productive, so waiting only burns SLO budget.
+SERVING_CRASH_EXIT_CODE = 78

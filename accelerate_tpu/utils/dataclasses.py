@@ -661,6 +661,23 @@ class ServingConfig(KwargsHandler):
       queue-depth samples). Lifetime percentiles average the whole run, so
       a long healthy prefix masks a current breach; the autoscaler
       (autoscale.py) and canary gates read this window instead.
+
+    Crash durability (journal.py — see docs/usage_guides/serving.md
+    "Surviving engine crashes"):
+
+    - ``journal_dir``: directory for the write-ahead request journal;
+      ``None`` (default) keeps journaling fully off. With it set, every
+      admission / progress batch / terminal status is durably logged and
+      ``ServingEngine.recover()`` rebuilds the queue after a process death:
+      completed requests return their cached rows (exactly-once — never
+      re-executed), in-flight requests replay bit-equal from the journaled
+      prompt + rng.
+    - ``journal_fsync``: durability policy — ``"every_record"`` (fsync per
+      append), ``"every_tick"`` (one fsync per engine tick; the default),
+      or ``"os"`` (flush to the page cache only — survives a process crash,
+      not host power loss).
+    - ``journal_segment_records``: appends per WAL segment before rotation
+      (seal + compaction of the sealed set).
     """
 
     enabled: bool = True
@@ -684,6 +701,9 @@ class ServingConfig(KwargsHandler):
     max_retries: int = 2
     max_idle_ticks: int = 100
     window_requests: int = 128
+    journal_dir: Optional[str] = None
+    journal_fsync: str = "every_tick"
+    journal_segment_records: int = 512
 
     def __post_init__(self):
         if self.n_slots < 1:
@@ -712,6 +732,13 @@ class ServingConfig(KwargsHandler):
             raise ValueError("max_idle_ticks must be >= 1")
         if self.window_requests < 1:
             raise ValueError("window_requests must be >= 1")
+        if self.journal_fsync not in ("every_record", "every_tick", "os"):
+            raise ValueError(
+                "journal_fsync must be 'every_record', 'every_tick', or "
+                f"'os', got {self.journal_fsync!r}"
+            )
+        if self.journal_segment_records < 1:
+            raise ValueError("journal_segment_records must be >= 1")
 
 
 @dataclass

@@ -36,6 +36,13 @@ Three rows, one JSON line each:
   swap latency, BandwidthTable-priced redistribution bytes, the canary
   window (routed counts + decision), and the faults block, with the
   zero-recompile swap evidenced by the executable census.
+- ``--journal`` (implies ``--serving``) adds one ``serving_journal`` row
+  per write-ahead-journal fsync policy (``every_record`` / ``every_tick`` /
+  ``os``) — the SAME trace with crash-durable request journaling on,
+  priced as tokens/s overhead vs the journal-off ``serving`` row — plus a
+  ``journal_recovery`` row: a journaled engine is abandoned mid-trace (a
+  simulated crash) and a fresh engine's measured ``recover()`` wall time,
+  recovered counts, and drained completions ride in the row.
 - ``--trace diurnal`` swaps the flat Poisson arrivals for the seeded
   diurnal generator (:func:`accelerate_tpu.autoscale.make_diurnal_trace`:
   low / 10x-high / low plateaus with a shifting prompt:decode mix) — ONE
@@ -139,6 +146,11 @@ def main():
                          "checkpoint into the live engine mid-trace through "
                          "a canary window; implies --serving)")
     ap.add_argument("--canary-fraction", type=float, default=0.25)
+    ap.add_argument("--journal", action="store_true",
+                    help="add serving_journal rows (WAL overhead per fsync "
+                         "policy vs journal-off) and a journal_recovery row "
+                         "(measured recover() time on a fresh engine after "
+                         "a simulated crash; implies --serving)")
     ap.add_argument("--autoscale", action="store_true",
                     help="add a serving_autoscale row (diurnal trace through "
                          "a half-mesh disagg engine with an "
@@ -165,7 +177,8 @@ def main():
         args.trace = "diurnal"
     if args.trace_out:
         args.tracing = True
-    if args.disagg or args.chaos or args.publish or args.autoscale:
+    if args.disagg or args.chaos or args.publish or args.autoscale \
+            or args.journal:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -374,6 +387,86 @@ def main():
             row["tracing"] = _tracing_block(tr_serve)
             export_tr = tr_serve
         print(json.dumps(row), flush=True)
+
+        # Journal rows: the same trace with the crash-durable write-ahead
+        # request journal on, one row per fsync policy — the durability tax
+        # priced against the journal-off `serving` row above. every_record
+        # pays an fsync per append, every_tick (the default) one per engine
+        # tick, os only flushes to the page cache.
+        if args.journal:
+            from accelerate_tpu.journal import JOURNAL_FSYNC_POLICIES
+
+            base_tps = st["tokens_per_s"]
+            jroot = tempfile.mkdtemp(prefix="gen_bench_journal_")
+            for pol in JOURNAL_FSYNC_POLICIES:
+                jcfg = ServingConfig(
+                    n_slots=slots, max_len=t_cap,
+                    max_prefill_chunk=max(16, args.prompt_len),
+                    journal_dir=os.path.join(jroot, pol), journal_fsync=pol)
+                jengine = ServingEngine(res_model, jcfg)
+                jengine.warmup()
+                _, jour_s = replay_trace(
+                    jengine, reqs, arrivals=list(arrivals),
+                    max_new_tokens=[int(b) for b in budgets])
+                jst = jengine.stats()
+                jj = jst["journal"]
+                print(json.dumps({
+                    "row": "serving_journal", "fsync": pol,
+                    "seconds": round(jour_s, 3),
+                    "tokens_per_s": jst["tokens_per_s"],
+                    "tokens_per_s_journal_off": base_tps,
+                    "overhead_pct": (round(100.0 * (base_tps - jst[
+                        "tokens_per_s"]) / base_tps, 2) if base_tps else None),
+                    "appends": jj["appends"], "syncs": jj["syncs"],
+                    "rotations": jj["rotations"],
+                    "bytes_written": jj["bytes_written"],
+                    "decode_executables": jst["decode_executables"],
+                    "steady_recompiles": jst["steady_recompiles"],
+                }), flush=True)
+                jengine.close()
+
+            # Measured recovery: feed the whole request set to a journaled
+            # engine, abandon it after a handful of ticks WITHOUT close()
+            # (a simulated crash — the WAL is the only survivor), then time
+            # a fresh engine's recover() over the same directory and drain
+            # the replayed queue to completion.
+            rcfg = ServingConfig(
+                n_slots=slots, max_len=t_cap,
+                max_prefill_chunk=max(16, args.prompt_len),
+                journal_dir=os.path.join(jroot, "recover"))
+            crash_engine = ServingEngine(res_model, rcfg)
+            crash_engine.warmup()
+            for i in range(n):
+                crash_engine.submit(reqs[i], max_new_tokens=int(budgets[i]),
+                                    client_request_id=f"bench-{i}")
+            for _ in range(16):
+                if crash_engine.pending:
+                    crash_engine.tick()
+            crash_engine.poll()
+            del crash_engine  # simulated crash: no close(), no flush
+            fresh = ServingEngine(res_model, rcfg)
+            fresh.warmup()
+            t0 = time.perf_counter()
+            rec = fresh.recover()
+            recover_wall_s = time.perf_counter() - t0
+            drained = 0
+            while fresh.pending:
+                fresh.tick()
+                drained += sum(1 for r in fresh.poll()
+                               if r["status"] == "ok")
+            print(json.dumps({
+                "row": "journal_recovery",
+                "recover_s": round(recover_wall_s, 4),
+                "recovered_inflight": rec["recovered_inflight"],
+                "recovered_terminal": rec["recovered_terminal"],
+                "records_scanned": rec["records"],
+                "segments": rec["segments"],
+                "torn_tails": rec["torn_tails"],
+                "corrupt_skipped": rec["corrupt_skipped"],
+                "drained_ok": drained,
+                "requests": n,
+            }), flush=True)
+            fresh.close()
 
         # Disaggregated row: the same trace through the two-mesh router —
         # planner-sized prefill/decode slices, streamed KV-page handoff. The

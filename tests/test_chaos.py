@@ -134,6 +134,8 @@ def test_injector_validation():
         "publish_manifest", "publish_transfer", "canary_window",
         # autoscaling
         "autoscale_decide", "resize_transfer", "load_spike",
+        # crash durability
+        "journal_append", "journal_compact", "engine_crash",
     }
 
 
@@ -458,7 +460,9 @@ def test_off_by_default_no_chaos_no_faults(llama):
     for i in ids:
         assert res[i]["status"] == "ok"
         assert set(res[i]) == {"id", "status", "tokens", "new_tokens",
-                               "ttft_s", "tpot_s", "weights_version"}
+                               "ttft_s", "tpot_s", "weights_version",
+                               "attempt", "recovered"}
+        assert res[i]["attempt"] == 1 and res[i]["recovered"] is False
     f = eng.stats()["faults"]
     assert f["injected"] == 0 and f["degraded"] is False
     assert all(v in (0, False) for v in f.values())

@@ -292,6 +292,7 @@ def test_classify_exit_table():
     assert classify_exit(75) == "preempted"  # PREEMPTION_EXIT_CODE
     assert classify_exit(76) == "stalled"  # TRAINING_STALLED_EXIT_CODE
     assert classify_exit(77) == "poisoned"  # POISONED_CHECKPOINT_EXIT_CODE
+    assert classify_exit(78) == "serving-crash"  # SERVING_CRASH_EXIT_CODE
     assert classify_exit(137) == "oom"
     assert classify_exit(-_signal.SIGKILL) == "oom"
     assert classify_exit(139) == "dead-host"  # chaos dead_host default
@@ -337,6 +338,23 @@ def test_supervisor_budget_poisoned_and_preempted():
 
     d = GangSupervisor(max_restarts=3).decide(0, uptime_s=10.0, num_processes=4)
     assert d.action == "stop" and d.classification == "ok"
+
+
+def test_supervisor_serving_crash_zero_backoff():
+    """A serving-engine death (rc 78) relaunches with ZERO backoff: the
+    request journal makes the relaunch immediately productive, so any sleep
+    only burns the SLO budget of the requests recover() will replay."""
+    from accelerate_tpu.commands.launch import GangSupervisor
+
+    sup = GangSupervisor(max_restarts=3, backoff_s=5.0)
+    d = sup.decide(78, uptime_s=2.0, num_processes=1)
+    assert d.action == "restart" and d.classification == "serving-crash"
+    assert d.delay_s == 0.0
+    # Still spends the restart budget — a crash-looping engine must stop.
+    sup.decide(78, uptime_s=2.0, num_processes=1)
+    sup.decide(78, uptime_s=2.0, num_processes=1)
+    d = sup.decide(78, uptime_s=2.0, num_processes=1)
+    assert d.action == "stop" and "budget exhausted" in d.reason
 
 
 def test_supervisor_refuses_deterministic_fatal():

@@ -729,6 +729,42 @@ def test_chaos_dead_host_exits_with_chosen_code(monkeypatch):
     assert ft.faults_injected == 1
 
 
+def test_chaos_dead_host_flushes_injected_log(monkeypatch):
+    """os._exit skips every atexit/finally, so the dead_host path must push
+    the injector's FULL injected log through telemetry (and close the
+    stream) before dying — the post-mortem keeps the fault schedule that
+    killed the run."""
+    ft = _manager(chaos=dict(seed=1, schedule=[
+        {"point": "host_heartbeat", "kind": "dead_host", "tick": 0}]))
+
+    class _Tel:
+        def __init__(self):
+            self.events = []
+            self.closed = False
+
+        def record_event(self, event, **fields):
+            self.events.append((event, fields))
+
+        def close(self):
+            self.closed = True
+
+    tel = _Tel()
+    ft.accelerator.telemetry = tel
+
+    class _Exit(BaseException):
+        pass
+
+    monkeypatch.setattr(
+        os, "_exit", lambda code: (_ for _ in ()).throw(_Exit()))
+    with pytest.raises(_Exit):
+        ft.observe_step({"loss": np.float32(1.0)})
+    logs = [f for e, f in tel.events if e == "chaos_injected_log"]
+    assert len(logs) == 1
+    assert logs[0]["injected"] and logs[0]["injected"][0]["kind"] == "dead_host"
+    assert logs[0]["summary"]["injected"] == 1
+    assert tel.closed  # the stream reached disk before the exit
+
+
 def test_chaos_dead_host_rank_targeting(monkeypatch):
     """A unit-pinned dead_host entry only kills the named rank."""
     ft = _manager(chaos=dict(seed=1, schedule=[

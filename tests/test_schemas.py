@@ -42,7 +42,7 @@ def _prompts(cfg, lengths, seed=3):
 
 POLL_ROW_KEYS = {
     "id", "status", "tokens", "new_tokens", "ttft_s", "tpot_s",
-    "weights_version",
+    "weights_version", "attempt", "recovered",
 }
 
 SERVING_STATS_KEYS = {
@@ -54,7 +54,14 @@ SERVING_STATS_KEYS = {
     "prefill_ladder", "n_slots", "mean_occupancy", "peak_occupancy",
     "mean_queue_depth", "slot_allocs", "slot_reuses", "steady_recompiles",
     "decode_executables", "prefill_executables", "weights_version",
-    "canary", "window", "faults",
+    "canary", "window", "faults", "journal",
+}
+
+JOURNAL_KEYS = {
+    "dir", "fsync", "appends", "bytes_written", "syncs", "rotations",
+    "compactions", "compact_aborts", "records_retired", "torn_writes",
+    "torn_tails", "corrupt_skipped", "pending", "retired",
+    "recovered_inflight", "recovered_terminal", "deduped",
 }
 
 WINDOW_KEYS = {
@@ -127,6 +134,18 @@ def test_serving_stats_schema(llama):
     assert set(stats) == SERVING_STATS_KEYS
     assert set(stats["window"]) == WINDOW_KEYS
     assert set(stats["faults"]) == FAULTS_KEYS
+    assert stats["journal"] is None  # journaling is off by default
+
+
+def test_journal_stats_schema(llama, tmp_path):
+    cfg, model = llama
+    engine = ServingEngine(
+        model, ServingConfig(n_slots=2, max_len=32, prefill_chunks=[4, 8],
+                             journal_dir=str(tmp_path / "wal")))
+    engine.run(_prompts(cfg, [5, 9]), max_new_tokens=2)
+    stats = engine.stats()
+    assert set(stats) == SERVING_STATS_KEYS
+    assert set(stats["journal"]) == JOURNAL_KEYS
 
 
 def test_disagg_stats_schema(llama):
