@@ -8,7 +8,7 @@ PYTEST = $(ENV) python -m pytest -q
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
         reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
-        autoscale-smoke trace-smoke gameday-smoke
+        autoscale-smoke trace-smoke gameday-smoke sdc-smoke smoke-all
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -204,6 +204,42 @@ faulttol-smoke:
 # device count itself, so this target sets no XLA_FLAGS.)
 reshard-smoke:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu.test_utils.scripts.reshard_smoke
+
+# Silent-data-corruption gate: the sdc.py sentinel end to end. A 4-rank
+# gloo gang draws a transient train_step/bit_flip on a vote tick — the
+# cross-replica integrity vote isolates the outlier, the redundant-compute
+# probe on the cached golden batch clears the silicon, and the majority
+# broadcast repairs in place (final loss bit-equal to a fault-free
+# reference, jit cache flat). A 2-rank gang draws the same flip sticky —
+# the probe reproduces it, the convicted rank quarantines itself on disk
+# and exits 79, classify_exit maps it to "sdc", and GangSupervisor orders
+# the zero-backoff SHRUNK relaunch that resumes from the newest verified
+# checkpoint with the host still excluded. A decode canary (known prompt,
+# pinned RNG, journal/poll-invisible) catches an injected decode_tick
+# bit_flip and shrinks the engine around the device via mark_device_dead.
+# Every leg replays bit-identically on its second seeded round. See
+# docs/usage_guides/fault_tolerance.md "Silent data corruption".
+sdc-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.sdc_smoke
+
+# Every acceptance gate back to back with a one-line pass/fail table and a
+# nonzero exit if any gate failed. Serial on purpose: the gates share the
+# CPU cores and several launch their own subprocess gangs.
+SMOKES = telemetry warmup serving plan reshard disagg chaos chaos-train \
+         publish autoscale trace faulttol gameday sdc
+smoke-all:
+	@fail=0; \
+	for s in $(SMOKES); do \
+	    start=$$(date +%s); \
+	    if $(MAKE) -s $$s-smoke >/tmp/smoke_$$s.log 2>&1; then \
+	        printf 'PASS  %-14s %4ss\n' $$s $$(( $$(date +%s) - start )); \
+	    else \
+	        printf 'FAIL  %-14s %4ss  (tail: /tmp/smoke_%s.log)\n' \
+	            $$s $$(( $$(date +%s) - start )) $$s; \
+	        fail=1; \
+	    fi; \
+	done; \
+	exit $$fail
 
 # Relay-recovery sequence: kernel health first (~3 min, skips cleanly if the
 # relay dropped again), then the full ladder (1B seq 2048/8192 + fp8 + int8

@@ -1596,6 +1596,20 @@ class Accelerator:
                 grad_shardings=grad_shardings,
             )
 
+        # SDC sentinel (sdc.py): when armed, every step's metrics carry a
+        # cheap fused fingerprint of the new params + grad norm. Computed
+        # INSIDE the jitted step so it folds into the one existing metrics
+        # fetch, observed one step lagged like loss/grad_norm.
+        _sdc_armed = (self.fault_tolerance is not None
+                      and self.fault_tolerance.sdc is not None)
+
+        def _maybe_digest(metrics, new_state, gnorm):
+            if _sdc_armed:
+                from .sdc import integrity_digest
+
+                metrics["sdc_digest"] = integrity_digest(new_state.params, gnorm)
+            return metrics
+
         if num_accum > 1:
 
             def step(state: TrainState, batch):
@@ -1620,7 +1634,9 @@ class Accelerator:
                 new_state, gnorm = _update(state, grads)
                 if mutable_state:
                     new_state = new_state.replace(extra_state=new_extra)
-                return new_state, {"loss": loss_sum / num_accum, "grad_norm": gnorm}
+                return new_state, _maybe_digest(
+                    {"loss": loss_sum / num_accum, "grad_norm": gnorm},
+                    new_state, gnorm)
 
         else:
 
@@ -1631,7 +1647,8 @@ class Accelerator:
                 new_state, gnorm = _update(state, grads)
                 if mutable_state:
                     new_state = new_state.replace(extra_state=new_extra)
-                return new_state, {"loss": loss, "grad_norm": gnorm}
+                return new_state, _maybe_digest(
+                    {"loss": loss, "grad_norm": gnorm}, new_state, gnorm)
 
         jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
         if self.compile_manager is not None:
@@ -1643,6 +1660,15 @@ class Accelerator:
             cm = self.compile_manager
             if cm is not None:
                 cm.observe(batch)  # new signatures land in the shapes manifest
+            if _sdc_armed:
+                sdc = self.fault_tolerance.sdc
+                if sdc.needs_golden:
+                    # First prepared step: snapshot (state, batch) to host and
+                    # pre-run the probe — it compiles the SAME executable the
+                    # real steps use (identical shapes + shardings), so every
+                    # later probe is recompile-free. Runs on restored copies:
+                    # buffer donation never touches the live state.
+                    sdc.capture_golden(jitted, state, batch)
             tel = self.telemetry
             if tel is None:
                 new_state, metrics = jitted(state, batch)

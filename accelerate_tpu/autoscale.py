@@ -445,7 +445,13 @@ class AutoscaleController:
         cooldown nor the breach damper applies (the resize budget still
         does not: survival beats quota). The surviving exact count is used
         when the shared planner gate validates it, else the largest viable
-        smaller size. A dead spare is only recorded."""
+        smaller size. A dead spare is only recorded.
+
+        "Gone" includes silently WRONG: the decode canary
+        (:class:`~accelerate_tpu.sdc.DecodeCanary`) routes a bit-wise
+        output mismatch through this same correctness-shrink, so a chip
+        producing finite-but-corrupt tokens is excised exactly like one
+        that stopped answering."""
         self.dead.add(device)
         tick = int(self.engine._stats["ticks"])
         if device not in self.engine._devices:

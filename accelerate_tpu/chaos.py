@@ -34,7 +34,11 @@ Serving fault kinds:
   quarantines it;
 - ``poison`` — a nonfinite (NaN) KV page: the transferred page (or, at
   ``decode_tick``, a live slot's page in place) is overwritten with NaN,
-  which the decode-side sentinel must catch.
+  which the decode-side sentinel must catch;
+- ``bit_flip`` (at ``decode_tick``) — silent data corruption: the emitted
+  token for one live slot is XOR'd with 1 after the host fetch —
+  wrong-but-finite, invisible to every NaN sentinel, caught only by the
+  serving decode canary's bit-wise golden comparison (sdc.py).
 
 Training injection points (drawn by the fault-tolerance manager when a
 ``FaultToleranceKwargs(chaos=...)`` handler arms it — fault_tolerance.py):
@@ -60,7 +64,13 @@ Training fault kinds:
   a REAL divergence flows through sentinel → rollback;
 - ``dead_host`` — the process exits with a chosen code (schedule entry's
   ``exit_code``, default :data:`DEAD_HOST_DEFAULT_EXIT_CODE`), driving the
-  launch supervisor's classify → backoff → relaunch path.
+  launch supervisor's classify → backoff → relaunch path;
+- ``bit_flip`` (at ``train_step``) — silent data corruption: the
+  host-observed integrity digest on the targeted rank goes wrong-but-finite
+  (``Fault.extra``: ``mode`` = ``"transient"`` | ``"sticky"``, optional
+  ``rank``/``leaf``). Only the SDC sentinel's cross-replica vote (sdc.py)
+  can see it; ``sticky`` also fails the redundant-compute probe, convicting
+  the silicon → ``SDC_EXIT_CODE`` quarantine + shrink-relaunch.
 
 Publication injection points (drawn by ``publish.WeightPublisher`` when
 constructed with ``chaos=...``):
@@ -178,6 +188,7 @@ FAULT_KINDS = (
     "transfer_error", "delay", "dead_lane", "poison",
     "nonfinite_grad", "slow_step", "torn_write", "corrupt_batch", "dead_host",
     "slo_regression", "version_mismatch", "flap", "spike", "crash",
+    "bit_flip",
 )
 
 # An injected dead host exits 139 (128 + SIGSEGV) unless the schedule entry
@@ -189,10 +200,18 @@ DEAD_HOST_DEFAULT_EXIT_CODE = 139
 # construction so a typo'd chaos spec fails loudly, not silently-never-fires.
 _POINT_KINDS = {
     "prefill_dispatch": ("transfer_error",),
-    "decode_tick": ("poison",),
+    # decode_tick bit_flip (sdc.py): the emitted token for one live slot is
+    # XOR'd with 1 after the host fetch — wrong-but-finite output the decode
+    # canary must catch bit-wise (NaN sentinels never see it).
+    "decode_tick": ("poison", "bit_flip"),
     "handoff_device_put": ("transfer_error", "delay", "poison"),
     "lane_health": ("dead_lane",),
-    "train_step": ("nonfinite_grad", "slow_step"),
+    # train_step bit_flip (sdc.py): the host-observed integrity digest on the
+    # targeted rank is corrupted — finite, so only cross-replica voting sees
+    # it. ``Fault.extra`` carries ``mode`` ("transient"|"sticky") and
+    # optionally ``rank``/``leaf``; sticky also trips the redundant-compute
+    # probe, convicting the silicon (SDC_EXIT_CODE).
+    "train_step": ("nonfinite_grad", "slow_step", "bit_flip"),
     "collective_op": ("slow_step",),
     "checkpoint_save": ("torn_write",),
     "dataloader_batch": ("corrupt_batch",),

@@ -27,12 +27,7 @@ import time
 from typing import NamedTuple, Optional
 
 from .config_args import LaunchConfig, load_config_file
-from ..utils.constants import (
-    POISONED_CHECKPOINT_EXIT_CODE,
-    PREEMPTION_EXIT_CODE,
-    SERVING_CRASH_EXIT_CODE,
-    TRAINING_STALLED_EXIT_CODE,
-)
+from ..utils.constants import PROTOCOL_EXIT_CLASSES
 
 
 def add_launch_args(p: argparse.ArgumentParser):
@@ -156,26 +151,19 @@ def _spawn(cmd, env, rank: int | None = None) -> subprocess.Popen:
 def classify_exit(rc: int) -> str:
     """Map a gang exit code to a failure class the supervisor acts on.
 
-    The resumable protocol codes come first (workers choose them on purpose:
-    fault_tolerance.py preemption/watchdog/divergence paths); everything else
-    is inferred from POSIX conventions — negative rc is a Popen "killed by
-    signal", 128+N is a shell-style signal death (the chaos ``dead_host``
-    default is 139 = 128+SIGSEGV)."""
+    The resumable protocol codes come first, resolved from the single
+    source of truth in ``utils.constants.EXIT_CODE_TABLE`` (workers choose
+    them on purpose: fault_tolerance.py preemption/watchdog/divergence
+    paths, serving.py engine crashes, sdc.py sticky-corruption convictions);
+    everything else is inferred from POSIX conventions — negative rc is a
+    Popen "killed by signal", 128+N is a shell-style signal death (the chaos
+    ``dead_host`` default is 139 = 128+SIGSEGV)."""
     if rc == 0:
         return "ok"
     if rc == 130 or rc == -signal.SIGINT:
         return "interrupted"
-    if rc == PREEMPTION_EXIT_CODE:
-        return "preempted"
-    if rc == TRAINING_STALLED_EXIT_CODE:
-        return "stalled"
-    if rc == POISONED_CHECKPOINT_EXIT_CODE:
-        return "poisoned"
-    if rc == SERVING_CRASH_EXIT_CODE:
-        # A hard serving-engine death (chaos engine_crash or a real one). The
-        # request journal makes a relaunch immediately productive: recover()
-        # replays the WAL, so the supervisor restarts with zero backoff.
-        return "serving-crash"
+    if rc in PROTOCOL_EXIT_CLASSES:
+        return PROTOCOL_EXIT_CLASSES[rc]
     if rc == 137 or rc == -signal.SIGKILL:
         # SIGKILL is almost always the kernel OOM killer on a training host.
         return "oom"
@@ -264,7 +252,19 @@ class GangSupervisor:
                 reason=f"restart budget exhausted ({self.max_restarts})",
             )
         new_procs = None
-        if cls == "dead-host":
+        if cls == "sdc":
+            # Sticky silent corruption convicted one host's silicon; the
+            # worker already quarantined it on disk (sdc_quarantine.json).
+            # Shrink immediately — correctness, not a death streak — so the
+            # relaunch excludes it, and skip backoff: waiting cannot heal
+            # bad hardware.
+            from ..resharding import shrink_world_size
+
+            shrunk = shrink_world_size(num_processes, lost=1, layout=self.layout)
+            if shrunk is not None and shrunk < num_processes:
+                new_procs = shrunk
+            self._dead_streak = 0
+        elif cls == "dead-host":
             self._dead_streak += 1
             if self.shrink_after and self._dead_streak >= self.shrink_after:
                 from ..resharding import shrink_world_size
@@ -277,7 +277,7 @@ class GangSupervisor:
             self._dead_streak = 0
         n = self.restarts_used
         self.restarts_used += 1
-        delay = (0.0 if cls in ("preempted", "serving-crash")
+        delay = (0.0 if cls in ("preempted", "serving-crash", "sdc")
                  else _backoff_s(n, self.backoff_s, self.backoff_cap_s))
         return SupervisorDecision("restart", cls, delay_s=delay, num_processes=new_procs)
 

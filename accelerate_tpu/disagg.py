@@ -200,6 +200,9 @@ class DisaggServingEngine(ServingEngine):
             bw=BandwidthTable.from_dict(dc.bandwidths),
             kv_bytes_per_token=kvb, n_prefill=dc.n_prefill_devices,
         )
+        # The decode slice is also what the SDC decode canary (sdc.py)
+        # convicts on a bit-wise output mismatch: decode_devices[0] is the
+        # quarantine target handed to the autoscaler's mark_device_dead.
         self.prefill_devices = devs[:self.slice_plan.n_prefill]
         self.decode_devices = devs[self.slice_plan.n_prefill:]
 

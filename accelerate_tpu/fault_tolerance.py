@@ -597,6 +597,18 @@ class FaultToleranceManager:
 
             chaos = FaultInjector(**chaos)
         self.chaos = chaos
+        # SDC sentinel (sdc.py): armed only by FaultToleranceKwargs(sdc=...);
+        # every hook below is a single None check. Independent of the
+        # divergence sentinel policy — silent corruption is finite-but-wrong,
+        # invisible to nonfinite checks.
+        sdc = handler.sdc
+        if sdc is not None:
+            from .sdc import SDCConfig, SDCSentinel
+
+            if isinstance(sdc, dict):
+                sdc = SDCConfig(**sdc)
+            sdc = SDCSentinel(self, sdc)
+        self.sdc = sdc
         self.faults_injected = 0
         self._step_ticks = 0
         self._save_ticks = 0
@@ -674,6 +686,11 @@ class FaultToleranceManager:
                     "seconds", self.chaos.slow_step_s)))
             elif f.kind == "nonfinite_grad":
                 poison = True
+            elif f.kind == "bit_flip" and self.sdc is not None:
+                # Silent corruption: the NEXT observed digest on this rank is
+                # flipped finite-but-wrong (sdc.py folds it in at the lag
+                # swap). No NaN, no poison — only the vote can see it.
+                self.sdc.note_bit_flip(f)
         return poison
 
     def _chaos_save_attempt(self, tick: int, attempt: int) -> None:
@@ -1021,6 +1038,13 @@ class FaultToleranceManager:
         if self.watchdog is not None:
             self.watchdog.note_step(tick)  # may raise TrainingStalledError
             self.watchdog.maybe_heartbeat(tick)
+        if self.sdc is not None:
+            # Cross-replica integrity vote (lagged, collective on vote
+            # ticks). Runs regardless of the divergence-sentinel policy.
+            verdict = self.sdc.observe(
+                metrics if isinstance(metrics, dict) else None, tick, slot)
+            if verdict == "repair":
+                return self._sdc_repair(slot)
         if self.handler.sentinel == "off":
             return None
         pending, self._pending_metrics = self._pending_metrics, None
@@ -1106,6 +1130,50 @@ class FaultToleranceManager:
         self._event(
             "rollback", step=step, reason=reason, dir=restored,
             restored_step=restored_step, rollbacks=self.rollbacks_done,
+        )
+        return new_state
+
+    def _sdc_repair(self, slot: int):
+        """A vote mismatch the probe classified as *transient*: repair in
+        place and return the replacement TrainState. ``repair="broadcast"``
+        re-syncs params from a majority replica (falling back to rollback
+        when the vote had no majority to trust); ``"rollback"`` restores the
+        newest verified checkpoint — the replay is bit-equal to fault-free
+        because the corruption lived only in one replica's observed digest
+        stream, never in the verified bytes on disk."""
+        step = self.accelerator.step
+        mode = self.sdc.config.repair
+        new_state = None
+        if mode == "broadcast":
+            try:
+                new_state = self.sdc.broadcast_params(slot)
+            except Exception as e:
+                logger.warning(
+                    "fault_tolerance: sdc broadcast repair failed (%s) — "
+                    "falling back to rollback.", e)
+        restored = None
+        if new_state is None:
+            mode = "rollback"
+            try:
+                restored = self.accelerator.load_state()
+            except FileNotFoundError as e:
+                from .sdc import SDCError
+
+                raise SDCError(
+                    "transient silent corruption detected but the rollback "
+                    f"repair found no verified checkpoint to restore: {e}"
+                ) from e
+            new_state = self.accelerator._train_states[slot]
+        self.sdc.note_repair(mode)
+        # Both repair paths invalidate the in-flight lagged metrics: the
+        # pending digest/loss describe a step the repair just rewound.
+        self._pending_metrics = None
+        self.sentinel.reset()
+        restored_step = (int(np.asarray(new_state.step))
+                         if new_state is not None else -1)
+        self._event(
+            "sdc_repair", step=step, mode=mode, dir=restored,
+            restored_step=restored_step, repairs=self.sdc.repairs_done,
         )
         return new_state
 

@@ -607,6 +607,9 @@ class ServingEngine:
         self._poison_op = None       # lazily jitted chaos-only program
         self._draining = False
         self._idle_ticks = 0
+        # Decode canary (sdc.py DecodeCanary): attached via
+        # attach_sdc_canary(); every tick-end hook is a single None check.
+        self._sdc_canary = None
         self._has_deadlines = self.config.deadline_s is not None
         if self.tracing is not None:
             # metrics_text() parity: the Prometheus snapshot reads the same
@@ -870,6 +873,11 @@ class ServingEngine:
                 )
         else:
             self._idle_ticks = 0
+        if self._sdc_canary is not None:
+            # Deliberately the LAST thing in the tick: a canary mismatch may
+            # quarantine a decode device and resize the engine live, and
+            # nothing after this point touches engine state.
+            self._sdc_canary.on_tick()
 
     def _expire_deadlines(self) -> None:
         now = time.perf_counter()
@@ -905,7 +913,7 @@ class ServingEngine:
             # the retry replays bit-equal.
             req.weights_version = self._route_version()
             req.canary = self._canary is not None
-            if self._journal is not None:
+            if self._journal is not None and not self._journal_suppressed(req.id):
                 self._journal.append(
                     {"t": "bind", "rid": req.id,
                      "weights_version": req.weights_version,
@@ -964,7 +972,8 @@ class ServingEngine:
             self._prefilling.remove(req)
             req.first_token_t = time.perf_counter()
             req.out.append(int(tok))  # small host fetch — the TTFT moment
-            if self._journal is not None:
+            if (self._journal is not None
+                    and not self._journal_suppressed(req.id)):
                 self._journal_tokens.setdefault(req.id, []).append(
                     req.out[-1])
             if tr is not None:
@@ -1007,10 +1016,17 @@ class ServingEngine:
         return groups
 
     def _decode_tick(self) -> None:
+        flip_slot = None
         if self.chaos is not None and self._decoding:
             fault = self.chaos.draw("decode_tick", self._stats["ticks"])
             if fault is not None and fault.kind == "poison":
                 self._poison_slot(min(self._decoding))
+            elif fault is not None and fault.kind == "bit_flip":
+                # Silent decode corruption: the emitted token is XOR'd AFTER
+                # the host fetch — device state untouched, output finite and
+                # wrong. Only the decode canary (sdc.py) can see it.
+                flip_slot = int((fault.extra or {}).get(
+                    "slot", min(self._decoding)))
         live = len(self._decoding)
         self._stats["occupancy_sum"] += live
         self._stats["peak_occupancy"] = max(self._stats["peak_occupancy"], live)
@@ -1039,6 +1055,10 @@ class ServingEngine:
             # reading only the rows that group's mask advanced.
             tok_np, done_np, bad_np = jax.device_get(
                 (tok, self._state.done, bad))
+            if flip_slot is not None and mask[flip_slot]:
+                tok_np = np.array(tok_np)
+                tok_np[flip_slot] ^= 1
+                flip_slot = None  # one flip per tick, not per version group
             for slot, req in list(self._decoding.items()):
                 if req.weights_version != version or not mask[slot]:
                     continue
@@ -1046,7 +1066,8 @@ class ServingEngine:
                     self._on_poisoned_slot(slot, req)
                     continue
                 req.out.append(int(tok_np[slot]))
-                if self._journal is not None:
+                if (self._journal is not None
+                        and not self._journal_suppressed(req.id)):
                     self._journal_tokens.setdefault(req.id, []).append(
                         req.out[-1])
                 if bool(done_np[slot]):
@@ -1126,7 +1147,7 @@ class ServingEngine:
             # Exactly-once at the API: a duplicate submit with this key
             # re-emits the cached row instead of re-running the request.
             self._cached_rows[req.id] = result
-        if self._journal is not None:
+        if self._journal is not None and not self._journal_suppressed(req.id):
             self._journal_tokens.pop(req.id, None)
             # Terminal rows are self-contained (the full padded token row
             # rides along) so compaction can retire the request's working
@@ -1687,6 +1708,28 @@ class ServingEngine:
         counts), or None."""
         return dict(self._canary) if self._canary is not None else None
 
+    # -- decode canary (sdc.py) --------------------------------------------
+
+    def attach_sdc_canary(self, canary) -> None:
+        """Register a :class:`~accelerate_tpu.sdc.DecodeCanary` (called by
+        its constructor). The canary rides ``_end_tick`` — one per engine."""
+        self._sdc_canary = canary
+
+    def _journal_suppressed(self, rid: int) -> bool:
+        """True for the decode canary's in-flight probe: its progress and
+        terminal records must reach neither the WAL (phantom replay at
+        recover()) nor poll() — the warmup() suppression contract, but
+        per-request because probes fly amid real traffic."""
+        c = self._sdc_canary
+        return c is not None and c._inflight == rid
+
+    def sdc_stats(self) -> Optional[dict]:
+        """The ``sdc`` telemetry block: decode-canary probe/mismatch/
+        quarantine counters — or None with no canary attached."""
+        if self._sdc_canary is None:
+            return None
+        return self._sdc_canary.summary()
+
     def cohort_stats(self, version: int, warmup: int = 0) -> Optional[dict]:
         """SLO aggregates for one canary cohort, skipping that cohort's
         first ``warmup`` terminal events (warm caches / first-dispatch noise
@@ -1824,6 +1867,10 @@ class ServingEngine:
             # The trace restarts with the metrics: warmup spans would
             # otherwise pollute explain()/the tick-domain replay invariant.
             self.tracing.reset()
+        if self._sdc_canary is not None:
+            # Probe counters restart with the metrics; the golden row stays
+            # armed (it fingerprints the weights, not the run).
+            self._sdc_canary.reset_counters()
 
     # -- reporting ---------------------------------------------------------
 
@@ -1889,6 +1936,7 @@ class ServingEngine:
             "prefill_executables": execs["prefill"],
             "weights_version": self._weights_version,
             "canary": self.canary_status(),
+            "sdc": self.sdc_stats(),
             "window": self.window_stats(),
             "faults": self.fault_stats(),
             "journal": self.journal_stats(),

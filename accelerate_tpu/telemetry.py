@@ -430,7 +430,9 @@ class TelemetryRecorder:
         try:
             times = np.asarray(gather(np.asarray([wall_s], np.float64)), np.float64)
         except Exception as e:  # a failed probe must never kill training
-            logger.warning(f"telemetry: straggler probe failed: {e}")
+            # warning_once keyed by the message: a wedged rank fails every
+            # probe tick identically, and a long stall must not flood the log.
+            logger.warning_once(f"telemetry: straggler probe failed: {e}")
             return
         finally:
             collective_counters.enabled = was_enabled
@@ -779,6 +781,10 @@ class TelemetryRecorder:
                 "injected": self._faults["injected"],
                 "by_site": dict(sorted(self._faults["by_site"].items())),
             }
+        if ft is not None and getattr(ft, "sdc", None) is not None:
+            # SDC-sentinel block (sdc.py): digest/vote/probe/repair/
+            # quarantine tallies; bench rows embed it next to "faults".
+            out["sdc"] = ft.sdc.summary()
         if ft is not None and ft.watchdog is not None:
             # Stall-detection ladder counts + last per-rank ages
             # (fault_tolerance.py StepWatchdog).

@@ -1,0 +1,526 @@
+"""`make sdc-smoke`: the silent-data-corruption sentinel end to end on the
+CPU mesh (sdc.py + chaos.py + fault_tolerance.py + commands/launch.py +
+serving.py).
+
+Three legs, each seeded and run twice so the whole story replays
+bit-identically:
+
+1. **Transient** — a 4-rank gloo gang (2 devices per rank) trains with the
+   sentinel armed (``vote_every=2``, ``repair="broadcast"``). A scheduled
+   ``train_step``/``bit_flip`` corrupts rank 0's integrity digest on a vote
+   tick. The cross-replica vote isolates the outlier (majority {1,2,3}),
+   ALL ranks re-run the jitted step on the cached golden batch, the probe
+   matches golden (transient — the silicon is fine), and the majority
+   broadcast repairs rank 0 in place. The run finishes with its final loss
+   BIT-EQUAL to a fault-free 4-rank reference, and the probe replay hits
+   the existing step executable (jit cache size stays 1 — zero steady
+   recompiles).
+2. **Sticky** — a 2-rank gang draws the same flip in ``sticky`` mode: no
+   majority at n=2, so both ranks probe; the corruption reproduces on the
+   golden batch for rank 0, which records itself in
+   ``sdc_quarantine.json`` and exits ``SDC_EXIT_CODE`` (79); the peer sees
+   the verdict and exits clean. The parent then plays supervisor:
+   ``classify_exit(79) == "sdc"`` and ``GangSupervisor.decide`` orders an
+   immediate zero-backoff relaunch SHRUNK to 1 process, which resumes from
+   the newest verified checkpoint (``automatic_resume`` +
+   ``ACCELERATE_RESTART_ATTEMPT``) with the quarantined host still on the
+   exclusion list.
+3. **Decode canary** — a disaggregated engine serves only canary probes
+   (known prompt, greedy, pinned RNG). A ``decode_tick``/``bit_flip``
+   corrupts one sampled token mid-probe; the canary's bit-wise compare
+   against its golden tokens trips, the decode device is reported to the
+   autoscaler (``mark_device_dead``), and the engine shrinks around it.
+   Probe rows never reach ``poll()`` or the request journal.
+
+The worker subprocess is this same file with ``--worker``.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+TOTAL_STEPS = 8
+SAVE_AT = 2
+VOTE_EVERY = 2
+CHAOS_SEED = 7
+# The flip corrupts exactly one tick's digest, so it must land on a vote
+# tick (tick % VOTE_EVERY == 0) to be observed; real sticky corruption
+# persists into the params and gets caught on the next vote regardless.
+FLIP_TICK = 4
+GANG_TIMEOUT_S = 420.0
+
+# Serving leg: probes every 8 ticks; the first probe decodes over ticks
+# 9..12, so the scheduled flip at tick 10 lands mid-probe.
+CANARY_EVERY = 8
+CANARY_FLIP_TICK = 10
+CANARY_TICKS = 40
+
+
+def _schedule(mode):
+    if mode == "none":
+        return None
+    return [{"point": "train_step", "kind": "bit_flip", "tick": FLIP_TICK,
+             "unit": 0, "mode": mode}]
+
+
+# ---------------------------------------------------------------------------
+# Training worker (one gang rank, or the shrunk single-process relaunch)
+# ---------------------------------------------------------------------------
+
+
+def worker(project_dir, status_file, mode, repair, resume):
+    import jax
+    import optax
+    import flax.linen as nn
+
+    from accelerate_tpu import Accelerator, Model
+    from accelerate_tpu.utils import (
+        FaultToleranceKwargs,
+        ProjectConfiguration,
+        TelemetryKwargs,
+        set_seed,
+    )
+
+    set_seed(0)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(1)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32)
+
+    class Dataset:
+        def __len__(self):
+            return len(x)
+
+        def __getitem__(self, i):
+            return {"x": x[i], "y": y[i]}
+
+    class Spec:
+        dataset = Dataset()
+        batch_size = 16
+        sampler = None
+        drop_last = False
+
+    schedule = _schedule(mode)
+    ft_kwargs = FaultToleranceKwargs(
+        sentinel="warn",
+        chaos=dict(seed=CHAOS_SEED, schedule=schedule) if schedule else None,
+        sdc=dict(vote_every=VOTE_EVERY, repair=repair),
+    )
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir,
+            automatic_checkpoint_naming=True,
+            automatic_resume=resume,
+        ),
+        kwargs_handlers=[ft_kwargs, TelemetryKwargs(log_every=0)],
+    )
+    print(f"SDC_RANK {acc.process_index}/{acc.num_processes} "
+          f"devices={jax.device_count()}", flush=True)
+    module = Net()
+    model = Model.from_flax(module, jax.random.key(0), x[:1])
+    model, _, dl = acc.prepare(model, optax.adam(1e-2), Spec())
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        pred = module.apply({"params": params}, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step = acc.prepare_train_step(loss_fn)
+    state = acc.train_state
+    done = int(np.asarray(state.step))
+    saved = done >= SAVE_AT
+    last_loss = None
+    ft = acc.fault_tolerance
+
+    def finish():
+        g = ft.sdc._golden if ft.sdc is not None else None
+        cache = getattr(g["step_fn"], "_cache_size", lambda: None)() if g else None
+        status = {
+            "rank": acc.process_index,
+            "world": acc.num_processes,
+            "final_step": done,
+            "final_loss": last_loss,
+            "sdc": ft.sdc.summary() if ft.sdc is not None else None,
+            "fault_log": list(ft.chaos.injected) if ft.chaos is not None else [],
+            "step_cache_size": cache,
+        }
+        with open(status_file, "w") as f:
+            json.dump(status, f)
+        print(f"SDC_DONE {done} {last_loss}", flush=True)
+        if acc.num_processes == 1:
+            acc.end_training()
+            return 0
+        # Gang teardown after a peer was convicted (coordinator may already
+        # be gone) cannot complete the distributed barrier — exit directly.
+        os._exit(0)
+
+    while done < TOTAL_STEPS:
+        for batch in dl:
+            state, metrics = step(state, batch)
+            if ft.sdc is not None and ft.sdc.peer_quarantined:
+                print("SDC_PEER_QUARANTINED", flush=True)
+                return finish()
+            new_done = int(np.asarray(state.step))
+            if new_done < done:  # repair rolled the step counter back
+                done = new_done
+                break
+            done = new_done
+            last_loss = float(np.asarray(metrics["loss"]))
+            print(f"SDC_STEP {done} {last_loss}", flush=True)
+            if done >= SAVE_AT and not saved:
+                acc.save_state()
+                saved = True
+            if done >= TOTAL_STEPS:
+                break
+    return finish()
+
+
+# ---------------------------------------------------------------------------
+# Gang launcher (parent side)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _worker_cmd(project_dir, status_file, mode, repair, resume=False):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           f"--project-dir={project_dir}", f"--status-file={status_file}",
+           f"--mode={mode}", f"--repair={repair}"]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def _base_env(n_devices):
+    env = {**os.environ}
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"), _repo_root(), os.getcwd()) if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    # A convicted rank kills the coordinator mid-run; peers must not hang in
+    # gloo retries during teardown.
+    env.pop("ACCELERATE_COORDINATOR_ADDRESS", None)
+    env.pop("ACCELERATE_NUM_PROCESSES", None)
+    env.pop("ACCELERATE_PROCESS_INDEX", None)
+    env.pop("ACCELERATE_LOCAL_PROCESS_INDEX", None)
+    env.pop("ACCELERATE_RESTART_ATTEMPT", None)
+    return env
+
+
+def _run_gang(tmp, name, n, mode, repair):
+    """Launch an n-rank gloo gang (8 devices split evenly) and collect each
+    rank's (exit code, status dict or None)."""
+    project_dir = os.path.join(tmp, name)
+    os.makedirs(project_dir, exist_ok=True)
+    port = _free_port()
+    procs = []
+    for i in range(n):
+        env = _base_env(8 // n)
+        env.update(
+            ACCELERATE_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            ACCELERATE_NUM_PROCESSES=str(n),
+            ACCELERATE_PROCESS_INDEX=str(i),
+            ACCELERATE_LOCAL_PROCESS_INDEX=str(i),
+        )
+        status_file = os.path.join(project_dir, f"status_{i}.json")
+        log = open(os.path.join(project_dir, f"rank_{i}.log"), "w")
+        procs.append((subprocess.Popen(
+            _worker_cmd(project_dir, status_file, mode, repair),
+            stdout=log, stderr=subprocess.STDOUT, env=env), log, status_file))
+    deadline = time.monotonic() + GANG_TIMEOUT_S
+    results = []
+    for p, log, status_file in procs:
+        try:
+            rc = p.wait(timeout=max(5.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc = -9
+        log.close()
+        status = None
+        if os.path.exists(status_file):
+            with open(status_file) as f:
+                status = json.load(f)
+        results.append((rc, status))
+    for i, (rc, status) in enumerate(results):
+        if rc not in (0, 79) or (rc == 0 and status is None):
+            with open(os.path.join(project_dir, f"rank_{i}.log")) as f:
+                sys.stderr.write(f.read()[-4000:])
+            raise AssertionError(f"{name} rank {i} failed rc={rc}")
+    print(json.dumps({"row": "gang", "name": name, "world": n, "mode": mode,
+                      "repair": repair,
+                      "exit_codes": [rc for rc, _ in results]}), flush=True)
+    return project_dir, results
+
+
+def _run_shrunk_resume(project_dir, attempt):
+    """The supervisor's shrunk relaunch: 1 process, all 8 devices, elastic
+    resume from the gang's newest verified checkpoint."""
+    env = _base_env(8)
+    env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
+    status_file = os.path.join(project_dir, "status_resume.json")
+    log_path = os.path.join(project_dir, "rank_resume.log")
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            _worker_cmd(project_dir, status_file, "none", "rollback",
+                        resume=True),
+            stdout=log, stderr=subprocess.STDOUT, env=env)
+        try:
+            rc = proc.wait(timeout=GANG_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = -9
+    if rc != 0 or not os.path.exists(status_file):
+        with open(log_path) as f:
+            sys.stderr.write(f.read()[-4000:])
+        raise AssertionError(f"shrunk relaunch failed rc={rc}")
+    with open(status_file) as f:
+        return json.load(f)
+
+
+def _load_quarantine(project_dir):
+    from accelerate_tpu.sdc import load_quarantine
+
+    q = load_quarantine(project_dir)["hosts"]
+    # Wall-clock stamps differ run to run; everything else must replay.
+    return [{k: v for k, v in e.items() if k != "time"} for e in q]
+
+
+# ---------------------------------------------------------------------------
+# Serving leg (in-parent: single process, 8 devices)
+# ---------------------------------------------------------------------------
+
+
+def _canary_round():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import (
+        AutoscaleConfig,
+        AutoscaleController,
+        DisaggConfig,
+        DisaggServingEngine,
+        FaultInjector,
+        Model,
+        ServingConfig,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.sdc import DecodeCanary
+    from accelerate_tpu.utils import set_seed
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise SystemExit(
+            "sdc-smoke needs an 8-device platform; run via `make sdc-smoke` "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    devs = devs[:8]
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    probe = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8),
+                                              dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+
+    sc = ServingConfig(n_slots=8, max_len=64, prefill_chunks=[16],
+                       temperature=0.0, seed=0, max_retries=3,
+                       max_idle_ticks=300, window_requests=8)
+    import tempfile
+
+    journal_dir = tempfile.mkdtemp(prefix="sdc_canary_journal_")
+    eng = DisaggServingEngine(model, sc, disagg=DisaggConfig(),
+                              devices=devs, journal=journal_dir)
+    eng.warmup()  # reset_metrics() re-zeroes the tick clock, so chaos
+    eng.chaos = FaultInjector(seed=CHAOS_SEED, schedule=[  # replays exactly
+        {"point": "decode_tick", "kind": "bit_flip",
+         "tick": CANARY_FLIP_TICK}])
+    auto = AutoscaleController(
+        eng, AutoscaleConfig(poll_ticks=8, window_min_requests=4,
+                             min_devices=2, max_resizes=4),
+        device_pool=devs)
+    canary = DecodeCanary(eng, every=CANARY_EVERY, autoscaler=auto)
+    canary.warmup()
+
+    leaked = []
+    for _ in range(CANARY_TICKS):
+        eng.tick()
+        auto.poll()
+        leaked.extend(eng.poll())
+    summary = canary.summary()
+    out = {
+        "canary": summary,
+        "stats_sdc": eng.stats()["sdc"],
+        "dead_device_shrinks": auto.stats()["dead_device_shrinks"],
+        "steady_recompiles": eng.stats()["steady_recompiles"],
+        "leaked_rows": len(leaked),
+        "probe_rids": list(canary.probe_rids),
+        "fault_log": list(eng.chaos.injected),
+    }
+    eng.close()
+    auto.close()
+    # Probe traffic must be invisible to crash durability: replaying the
+    # write-ahead journal finds no admit/bind/progress/terminal row for any
+    # canary rid (the engine's own warmup probes are a separate idiom).
+    from accelerate_tpu.journal import RequestJournal
+
+    records, _ = RequestJournal(journal_dir).replay()
+    out["journal_canary_records"] = len(
+        [r for r in records
+         if r.get("rid") is not None and int(r["rid"]) in set(canary.probe_rids)])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Acceptance
+# ---------------------------------------------------------------------------
+
+
+def main():
+    import tempfile
+
+    from accelerate_tpu.commands.launch import GangSupervisor, classify_exit
+    from accelerate_tpu.utils.constants import SDC_EXIT_CODE
+
+    tmp = tempfile.mkdtemp(prefix="sdc_smoke_")
+    print(json.dumps({"row": "start", "steps": TOTAL_STEPS,
+                      "vote_every": VOTE_EVERY, "flip_tick": FLIP_TICK,
+                      "tmp": tmp}), flush=True)
+
+    # -- Leg 1: transient flip in a 4-rank gang, broadcast repair ---------
+    _, ref = _run_gang(tmp, "ref4", 4, "none", "broadcast")
+    _, t1 = _run_gang(tmp, "transient1", 4, "transient", "broadcast")
+    _, t2 = _run_gang(tmp, "transient2", 4, "transient", "broadcast")
+
+    ref_losses = {json.dumps(s["final_loss"]) for _, s in ref}
+    assert len(ref_losses) == 1, f"reference gang ranks disagree: {ref_losses}"
+    for _, s in ref:
+        assert s["sdc"]["mismatches"] == 0 and s["sdc"]["repairs"] == 0, s
+        assert s["sdc"]["votes"] == TOTAL_STEPS // VOTE_EVERY, s
+
+    for name, run in (("transient1", t1), ("transient2", t2)):
+        for rc, s in run:
+            assert rc == 0 and s["final_step"] == TOTAL_STEPS, (name, rc, s)
+            sdc = s["sdc"]
+            assert sdc["mismatches"] == 1, (name, sdc)
+            assert sdc["probes"] == 1 and sdc["probes_failed"] == 0, (name, sdc)
+            assert sdc["repairs"] == 1 and sdc["quarantines"] == 0, (name, sdc)
+            # The probe replay and the broadcast repair reuse the live step
+            # executable: the jit cache never grows past the one entry.
+            assert s["step_cache_size"] in (None, 1), (name, s)
+            assert json.dumps(s["final_loss"]) in ref_losses, (
+                f"{name} rank {s['rank']} loss {s['final_loss']!r} not "
+                f"bit-equal to fault-free reference {ref_losses}")
+        flips = [s["fault_log"] for _, s in run]
+        assert flips[0] and flips[0][0]["kind"] == "bit_flip", flips
+        assert all(not f for f in flips[1:]), f"flip leaked off rank 0: {flips}"
+    assert [s for _, s in t1] == [s for _, s in t2], (
+        "transient rounds are not bit-identical")
+    print(json.dumps({"row": "transient", "repaired": True,
+                      "loss": next(iter(ref_losses))}), flush=True)
+
+    # -- Leg 2: sticky flip in a 2-rank gang -> exit 79 -> shrunk resume --
+    sticky = []
+    for name in ("sticky1", "sticky2"):
+        project_dir, results = _run_gang(tmp, name, 2, "sticky", "broadcast")
+        codes = [rc for rc, _ in results]
+        assert codes == [SDC_EXIT_CODE, 0], f"{name} exit codes {codes}"
+        peer = results[1][1]
+        assert peer["sdc"]["peer_quarantined"] is True, peer
+        assert peer["sdc"]["probes"] == 1 and peer["sdc"]["probes_failed"] == 0, peer
+        q = _load_quarantine(project_dir)
+        assert len(q) == 1 and q[0]["process_index"] == 0, q
+        assert "probe" in q[0]["reason"], q
+
+        # The parent IS the supervisor here: classify the gang's exit and
+        # let the real decision table order the shrunk zero-backoff restart.
+        assert classify_exit(SDC_EXIT_CODE) == "sdc"
+        sup = GangSupervisor(max_restarts=3)
+        decision = sup.decide(SDC_EXIT_CODE, uptime_s=5.0, num_processes=2)
+        assert decision.action == "restart", decision
+        assert decision.num_processes == 1, decision
+        assert decision.delay_s == 0.0, decision
+
+        resumed = _run_shrunk_resume(project_dir, attempt=sup.restarts_used)
+        assert resumed["world"] == 1, resumed
+        assert resumed["final_step"] == TOTAL_STEPS, resumed
+        assert resumed["final_step"] > SAVE_AT, resumed
+        assert resumed["sdc"]["quarantined_hosts"] == [q[0]["host"]], (
+            "quarantine did not persist into the shrunk relaunch", resumed)
+        sticky.append({"quarantine": q, "peer": peer,
+                       "resumed_loss": json.dumps(resumed["final_loss"])})
+        print(json.dumps({"row": "sticky", "name": name,
+                          "resumed_loss": resumed["final_loss"],
+                          "quarantined": q[0]["host"]}), flush=True)
+    assert sticky[0] == sticky[1], (
+        f"sticky rounds are not bit-identical:\n{sticky[0]}\n{sticky[1]}")
+
+    # -- Leg 3: decode canary catches an injected decode corruption -------
+    c1 = _canary_round()
+    c2 = _canary_round()
+    for c in (c1, c2):
+        s = c["canary"]
+        assert s["armed"] and s["probes"] >= 3, s
+        assert s["mismatches"] == 1 and s["quarantines"] == 1, s
+        assert s["suppressed_rows"] == s["probes"], s
+        assert c["stats_sdc"] == s, "stats()['sdc'] diverged from the canary"
+        assert c["dead_device_shrinks"] == 1, c
+        assert c["steady_recompiles"] == 0, c
+        assert c["leaked_rows"] == 0, "canary rows leaked into poll()"
+        assert len(c["probe_rids"]) >= 4, c["probe_rids"]  # warmup + probes
+        assert c["journal_canary_records"] == 0, (
+            "canary rows leaked into the journal", c)
+        assert c["fault_log"] and c["fault_log"][0]["kind"] == "bit_flip", c
+    assert c1 == c2, f"canary rounds are not bit-identical:\n{c1}\n{c2}"
+    print(json.dumps({"row": "canary", "probes": c1["canary"]["probes"],
+                      "quarantined": True,
+                      "shrinks": c1["dead_device_shrinks"]}), flush=True)
+
+    print(
+        "SDC SMOKE OK — transient flip voted out and repaired in place "
+        "(final loss bit-equal to fault-free, jit cache flat); sticky flip "
+        "convicted rank 0 (exit 79), supervisor relaunched shrunk with the "
+        "host quarantined and training resumed from the newest checkpoint; "
+        "decode canary caught the injected corruption and shrank around the "
+        "device; both seeded rounds bit-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", action="store_true")
+    parser.add_argument("--project-dir", default=None)
+    parser.add_argument("--status-file", default=None)
+    parser.add_argument("--mode", default="none",
+                        choices=("none", "transient", "sticky"))
+    parser.add_argument("--repair", default="broadcast",
+                        choices=("broadcast", "rollback"))
+    parser.add_argument("--resume", action="store_true")
+    args = parser.parse_args()
+    if args.worker:
+        sys.exit(worker(args.project_dir, args.status_file, args.mode,
+                        args.repair, args.resume))
+    sys.exit(main())

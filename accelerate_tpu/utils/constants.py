@@ -59,21 +59,87 @@ CHECKPOINT_MANIFEST_NAME = "manifest.json"
 # specs — so a restore onto a different mesh can plan a redistribution
 # schedule instead of failing on the shape/world-size mismatch.
 PLAN_MANIFEST_NAME = "plan_manifest.json"
-# Exit code a preemption-triggered save exits with (BSD EX_TEMPFAIL): the
-# launch gang loop treats it as "resumable — relaunch with
+# ----------------------------------------------------------------------
+# Exit-code protocol. Workers choose these codes ON PURPOSE (the protocol
+# rows below); everything else the supervisor infers from POSIX conventions
+# (negative rc = Popen killed-by-signal, 128+N = shell-style signal death).
+# EXIT_CODE_TABLE is the single source of truth: commands/launch.py
+# ``classify_exit`` resolves the protocol codes from it and the docs
+# render their exit-code table from the same rows — tests/test_cli.py pins
+# that table and classifier agree, so a new code added here without a
+# classification (or vice versa) fails loudly.
+# ----------------------------------------------------------------------
+
+# BSD EX_TEMPFAIL: a preemption-triggered save exits with this; the launch
+# gang loop treats it as "resumable — relaunch with
 # ACCELERATE_RESTART_ATTEMPT+1" instead of a crash.
 PREEMPTION_EXIT_CODE = 75
-# Exit code the step watchdog's self-preempt escalation hard-exits with when
-# the loop is too stuck to take the SIGTERM save path (fault_tolerance.py
-# StepWatchdog). The launch supervisor classifies it "stalled" — resumable
-# from the newest verified checkpoint, counted against the restart budget.
+# The step watchdog's self-preempt escalation hard-exits with this when the
+# loop is too stuck to take the SIGTERM save path (fault_tolerance.py
+# StepWatchdog). Resumable from the newest verified checkpoint, counted
+# against the restart budget.
 TRAINING_STALLED_EXIT_CODE = 76
-# Exit code for "the divergence is reproducible from the checkpoint"
-# (DivergenceError after max_rollbacks). The supervisor refuses to relaunch:
-# the same checkpoint feeds the same divergence, so a restart would thrash.
+# "The divergence is reproducible from the checkpoint" (DivergenceError
+# after max_rollbacks). The supervisor refuses to relaunch: the same
+# checkpoint feeds the same divergence, so a restart would thrash.
 POISONED_CHECKPOINT_EXIT_CODE = 77
-# Exit code a hard serving-engine death exits with (the chaos ``engine_crash``
-# default — serving.py). The launch supervisor classifies it "serving-crash"
-# and relaunches with ZERO backoff: the request journal (journal.py) makes a
-# relaunch immediately productive, so waiting only burns SLO budget.
+# A hard serving-engine death (the chaos ``engine_crash`` default —
+# serving.py). The supervisor relaunches with ZERO backoff: the request
+# journal (journal.py) makes a relaunch immediately productive, so waiting
+# only burns SLO budget.
 SERVING_CRASH_EXIT_CODE = 78
+# Sticky silent data corruption (sdc.py): the redundant-compute probe
+# reproduced a wrong-but-finite digest on a golden batch, so the silicon —
+# not the state — is bad. The host is quarantined on disk
+# (SDC_QUARANTINE_FILE) and the supervisor relaunches SHRUNK with zero
+# backoff, excluding it; elastic resume reshards the newest verified
+# checkpoint onto the smaller gang.
+SDC_EXIT_CODE = 79
+
+EXIT_CODE_TABLE = (
+    # (code, constant, classification, supervisor response)
+    {"code": 0, "constant": None, "classification": "ok",
+     "response": "stop — clean exit"},
+    {"code": PREEMPTION_EXIT_CODE, "constant": "PREEMPTION_EXIT_CODE",
+     "classification": "preempted",
+     "response": "relaunch with zero backoff; elastic resume restores the "
+                 "preemption auto-save"},
+    {"code": TRAINING_STALLED_EXIT_CODE, "constant": "TRAINING_STALLED_EXIT_CODE",
+     "classification": "stalled",
+     "response": "relaunch with backoff from the newest verified checkpoint"},
+    {"code": POISONED_CHECKPOINT_EXIT_CODE,
+     "constant": "POISONED_CHECKPOINT_EXIT_CODE",
+     "classification": "poisoned",
+     "response": "refuse — a relaunch replays the same divergence"},
+    {"code": SERVING_CRASH_EXIT_CODE, "constant": "SERVING_CRASH_EXIT_CODE",
+     "classification": "serving-crash",
+     "response": "relaunch with zero backoff; recover() replays the journal"},
+    {"code": SDC_EXIT_CODE, "constant": "SDC_EXIT_CODE",
+     "classification": "sdc",
+     "response": "relaunch SHRUNK with zero backoff, quarantined host "
+                 "excluded (persisted in the quarantine file)"},
+    {"code": 130, "constant": None, "classification": "interrupted",
+     "response": "stop — the operator hit Ctrl-C"},
+    {"code": 137, "constant": None, "classification": "oom",
+     "response": "relaunch with backoff (kernel OOM kill)"},
+    {"code": 139, "constant": "DEAD_HOST_DEFAULT_EXIT_CODE (chaos.py)",
+     "classification": "dead-host",
+     "response": "relaunch with backoff; --shrink_after_dead_hosts=K shrinks "
+                 "after K consecutive deaths"},
+)
+
+# The protocol subset of the table: codes a worker EXITS WITH DELIBERATELY,
+# which classify_exit resolves by exact lookup (the rest it infers from
+# POSIX signal conventions).
+PROTOCOL_EXIT_CLASSES = {
+    row["code"]: row["classification"]
+    for row in EXIT_CODE_TABLE
+    if row["code"] in (PREEMPTION_EXIT_CODE, TRAINING_STALLED_EXIT_CODE,
+                       POISONED_CHECKPOINT_EXIT_CODE, SERVING_CRASH_EXIT_CODE,
+                       SDC_EXIT_CODE)
+}
+
+# On-disk quarantine record (sdc.py): written next to the checkpoints when a
+# sticky-SDC probe convicts this host's silicon, read back by the next
+# launch so the exclusion survives gang restarts.
+SDC_QUARANTINE_FILE = "sdc_quarantine.json"

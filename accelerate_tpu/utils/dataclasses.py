@@ -358,6 +358,20 @@ class FaultToleranceKwargs(KwargsHandler):
       SAME recovery paths real failures take (sentinel → rollback, save
       retry → fallback, exit → gang relaunch). ``None`` (default) keeps
       every hook a single ``None`` check.
+    - **SDC sentinel** (``sdc``): an
+      :class:`~accelerate_tpu.sdc.SDCConfig` (or its constructor kwargs as
+      a dict) arms the silent-data-corruption defenses — every step
+      fingerprints the new params + grad norm inside the jitted step (one
+      fused reduction riding the existing metrics fetch, one step lagged),
+      every ``vote_every`` steps the dp replicas allgather and
+      majority-vote the digests bit-wise, and a mismatch triggers the
+      redundant-compute probe on a golden batch to classify *transient*
+      (repair in place: rollback or majority broadcast) vs *sticky* (bad
+      silicon: quarantine the host on disk, exit
+      ``utils.constants.SDC_EXIT_CODE`` so the supervisor relaunches the
+      gang SHRUNK without it). Independent of the divergence ``sentinel``
+      policy — SDC is finite-but-wrong, invisible to nonfinite checks.
+      ``None`` (default) keeps every hook a single ``None`` check.
     - **Step watchdog** (``watchdog``): a host-side thread + lagged
       per-step notes detecting a progress-free or straggling gang. A step
       older than ``watchdog_warn_s`` emits a ``training_stalled`` telemetry
@@ -393,6 +407,7 @@ class FaultToleranceKwargs(KwargsHandler):
     sentinel_ema_alpha: float = 0.1
     max_rollbacks: int = 2
     chaos: Optional[object] = None  # FaultInjector | dict of its kwargs
+    sdc: Optional[object] = None  # sdc.SDCConfig | dict of its kwargs
     watchdog: str = "off"  # off | warn | error | preempt
     watchdog_warn_s: float = 60.0
     watchdog_stall_s: float = 300.0
@@ -420,6 +435,14 @@ class FaultToleranceKwargs(KwargsHandler):
             raise ValueError("watchdog_poll_s must be > 0")
         if self.watchdog_heartbeat_every < 0:
             raise ValueError("watchdog_heartbeat_every must be >= 0")
+        if self.sdc is not None and not isinstance(self.sdc, dict):
+            # Lazy check (sdc.py imports jax at digest time): accept an
+            # SDCConfig instance or a dict of its kwargs.
+            if type(self.sdc).__name__ != "SDCConfig":
+                raise ValueError(
+                    "sdc must be an accelerate_tpu.sdc.SDCConfig or a dict "
+                    f"of its kwargs, got {type(self.sdc).__name__}"
+                )
 
 
 @dataclass

@@ -362,6 +362,16 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
             result["telemetry"]["faults"] = {
                 k: t["faults"].get(k) for k in ("injected", "by_site")
             }
+        # SDC block (sdc.py via telemetry summary): integrity-vote and
+        # probe counters ride next to the faults block so an sdc-enabled
+        # round shows whether the sentinel voted, convicted, or repaired
+        # alongside the step times the digests rode on.
+        if t.get("sdc"):
+            result["telemetry"]["sdc"] = {
+                k: t["sdc"].get(k)
+                for k in ("vote_every", "votes", "mismatches", "probes",
+                          "repairs", "quarantines")
+            }
         if t.get("watchdog"):
             wd = t["watchdog"]
             result["telemetry"]["watchdog"] = {

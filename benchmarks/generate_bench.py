@@ -43,6 +43,12 @@ Three rows, one JSON line each:
   ``journal_recovery`` row: a journaled engine is abandoned mid-trace (a
   simulated crash) and a fresh engine's measured ``recover()`` wall time,
   recovered counts, and drained completions ride in the row.
+- ``--sdc`` (implies ``--serving``) adds a ``serving_sdc`` row: the same
+  trace with a :class:`~accelerate_tpu.sdc.DecodeCanary` re-running a
+  known prompt through the live slot machinery every ``--sdc-every``
+  ticks — the silent-data-corruption detection tax priced as tokens/s
+  overhead vs the canary-off ``serving`` row (target < 1%), with the
+  ``sdc`` stats block (probes / mismatches / quarantines) in the row.
 - ``--trace diurnal`` swaps the flat Poisson arrivals for the seeded
   diurnal generator (:func:`accelerate_tpu.autoscale.make_diurnal_trace`:
   low / 10x-high / low plateaus with a shifting prompt:decode mix) — ONE
@@ -151,6 +157,13 @@ def main():
                          "policy vs journal-off) and a journal_recovery row "
                          "(measured recover() time on a fresh engine after "
                          "a simulated crash; implies --serving)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="add a serving_sdc row (same trace with a "
+                         "DecodeCanary probing every few ticks; prices the "
+                         "canary overhead against the canary-off serving "
+                         "row — target < 1%% tokens/s; implies --serving)")
+    ap.add_argument("--sdc-every", type=int, default=8,
+                    help="canary probe cadence in engine ticks for --sdc")
     ap.add_argument("--autoscale", action="store_true",
                     help="add a serving_autoscale row (diurnal trace through "
                          "a half-mesh disagg engine with an "
@@ -178,7 +191,7 @@ def main():
     if args.trace_out:
         args.tracing = True
     if args.disagg or args.chaos or args.publish or args.autoscale \
-            or args.journal:
+            or args.journal or args.sdc:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -467,6 +480,41 @@ def main():
                 "requests": n,
             }), flush=True)
             fresh.close()
+
+        # SDC-canary row: the same trace with a DecodeCanary re-running a
+        # known prompt through the live slot machinery every --sdc-every
+        # ticks — the silent-data-corruption detection tax priced against
+        # the canary-off `serving` row above (target: < 1% tokens/s). The
+        # probe rides the compiled decode ladder and is suppressed from
+        # poll()/journal/stats, so the only cost is its slot occupancy.
+        if args.sdc:
+            from accelerate_tpu.sdc import DecodeCanary
+
+            dcfg = ServingConfig(n_slots=slots, max_len=t_cap,
+                                 max_prefill_chunk=max(16, args.prompt_len))
+            dengine_sdc = ServingEngine(res_model, dcfg)
+            dengine_sdc.warmup()
+            canary = DecodeCanary(dengine_sdc, every=args.sdc_every)
+            canary.warmup()
+            dengine_sdc.reset_metrics()  # warmup probe out of the measurement
+            _, sdc_s = replay_trace(dengine_sdc, reqs,
+                                    arrivals=list(arrivals),
+                                    max_new_tokens=[int(b) for b in budgets])
+            dst_sdc = dengine_sdc.stats()
+            base_tps = st["tokens_per_s"]
+            print(json.dumps({
+                "row": "serving_sdc", "seconds": round(sdc_s, 3),
+                "canary_every": args.sdc_every,
+                "useful_tokens": dst_sdc["tokens_out"],
+                "tokens_per_s": dst_sdc["tokens_per_s"],
+                "tokens_per_s_canary_off": base_tps,
+                "overhead_pct": (round(100.0 * (base_tps - dst_sdc[
+                    "tokens_per_s"]) / base_tps, 2) if base_tps else None),
+                "ttft_p50_s": round(dst_sdc["ttft_p50_s"], 4),
+                "ttft_p95_s": round(dst_sdc["ttft_p95_s"], 4),
+                "steady_recompiles": dst_sdc["steady_recompiles"],
+                "sdc": dst_sdc["sdc"],
+            }), flush=True)
 
         # Disaggregated row: the same trace through the two-mesh router —
         # planner-sized prefill/decode slices, streamed KV-page handoff. The

@@ -298,8 +298,51 @@ def test_classify_exit_table():
     assert classify_exit(139) == "dead-host"  # chaos dead_host default
     assert classify_exit(-_signal.SIGSEGV) == "dead-host"
     assert classify_exit(134) == "dead-host"  # 128 + SIGABRT
+    assert classify_exit(79) == "sdc"  # SDC_EXIT_CODE
     assert classify_exit(1) == "fatal"
     assert classify_exit(17) == "fatal"
+
+
+def test_exit_code_table_is_single_source_of_truth():
+    """EXIT_CODE_TABLE (utils/constants.py) is what classify_exit and the
+    docs render from: every row's classification must round-trip through
+    the classifier, and every protocol constant must appear exactly once."""
+    from accelerate_tpu.commands.launch import classify_exit
+    from accelerate_tpu.utils import constants
+
+    codes = [row["code"] for row in constants.EXIT_CODE_TABLE]
+    assert codes == sorted(codes), "table rows must stay sorted by code"
+    assert len(codes) == len(set(codes)), "duplicate exit code rows"
+    for row in constants.EXIT_CODE_TABLE:
+        assert classify_exit(row["code"]) == row["classification"], row
+        assert row["response"], row
+        if row["constant"] is not None and row["constant"].isidentifier():
+            assert getattr(constants, row["constant"]) == row["code"], row
+    # The resumable protocol subset the classifier resolves table-first.
+    assert constants.PROTOCOL_EXIT_CLASSES == {
+        75: "preempted", 76: "stalled", 77: "poisoned",
+        78: "serving-crash", 79: "sdc"}
+
+
+def test_supervisor_sdc_shrinks_with_zero_backoff():
+    """A sticky-SDC conviction (exit 79) relaunches immediately and SHRUNK:
+    waiting cannot heal bad silicon, and the convicted host is already
+    quarantined on disk by the worker."""
+    from accelerate_tpu.commands.launch import GangSupervisor
+    from accelerate_tpu.utils.constants import SDC_EXIT_CODE
+
+    sup = GangSupervisor(max_restarts=3)
+    d = sup.decide(SDC_EXIT_CODE, uptime_s=100.0, num_processes=4)
+    assert d.action == "restart" and d.classification == "sdc"
+    assert d.delay_s == 0.0
+    assert d.num_processes == 2  # largest power of two <= 4 - 1
+    # Unlike dead-host, sdc shrinks on the FIRST conviction — correctness,
+    # not a death streak — and does not disturb the dead-host streak logic.
+    sup2 = GangSupervisor(max_restarts=9, shrink_after=2)
+    assert sup2.decide(139, uptime_s=5.0, num_processes=4).num_processes is None
+    d2 = sup2.decide(SDC_EXIT_CODE, uptime_s=5.0, num_processes=4)
+    assert d2.num_processes == 2 and d2.delay_s == 0.0
+    assert sup2._dead_streak == 0
 
 
 def test_restart_backoff_deterministic_and_capped():

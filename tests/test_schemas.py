@@ -54,7 +54,21 @@ SERVING_STATS_KEYS = {
     "prefill_ladder", "n_slots", "mean_occupancy", "peak_occupancy",
     "mean_queue_depth", "slot_allocs", "slot_reuses", "steady_recompiles",
     "decode_executables", "prefill_executables", "weights_version",
-    "canary", "window", "faults", "journal",
+    "canary", "window", "faults", "journal", "sdc",
+}
+
+# The engine ``stats()["sdc"]`` block (DecodeCanary.summary; None when no
+# canary is attached) and the telemetry ``summary()["sdc"]`` block
+# (SDCSentinel.summary) — bench.py embeds the latter next to ``faults``.
+SDC_CANARY_KEYS = {
+    "every", "armed", "golden_digest", "probes", "mismatches",
+    "quarantines", "suppressed_rows",
+}
+
+SDC_SUMMARY_KEYS = {
+    "vote_every", "repair", "digests", "votes", "mismatches", "probes",
+    "probes_failed", "repairs", "quarantines", "quarantined_hosts",
+    "peer_quarantined",
 }
 
 JOURNAL_KEYS = {
@@ -104,7 +118,7 @@ SUMMARY_ALWAYS = {
 }
 SUMMARY_OPTIONAL = {
     "faults", "watchdog", "serving", "reshard", "disagg", "publish",
-    "autoscale", "plan", "tracing", "executables", "compile",
+    "autoscale", "plan", "tracing", "executables", "compile", "sdc",
     "step_time_mean_s", "step_time_p50_s", "step_time_p90_s",
     "data_wait_mean_s", "ema_samples_per_s", "ema_tokens_per_s",
 }
@@ -189,3 +203,28 @@ def test_summary_block_schema(tmp_path):
         f"unpinned summary blocks: {keys - SUMMARY_ALWAYS - SUMMARY_OPTIONAL}")
     assert isinstance(acc.telemetry.tracing, TraceRecorder)
     assert set(out["tracing"]) == TRACING_STATS_KEYS
+
+
+def test_sdc_block_schemas(tmp_path):
+    """The two sdc.py observability blocks, pinned — and off by default:
+    ``stats()["sdc"]`` is None until a DecodeCanary is attached, and
+    ``summary()`` grows an ``sdc`` block only when the sentinel is armed."""
+    from accelerate_tpu.sdc import DecodeCanary, SDCConfig, SDCSentinel
+
+    class _Eng:  # the canary only touches these at construction time
+        def attach_sdc_canary(self, canary):
+            self.canary = canary
+
+    canary = DecodeCanary(_Eng(), every=4)
+    assert set(canary.summary()) == SDC_CANARY_KEYS
+    assert canary.summary()["armed"] is False
+
+    class _Acc:
+        project_dir = str(tmp_path)
+
+    class _Mgr:
+        accelerator = _Acc()
+
+    sentinel = SDCSentinel(_Mgr(), SDCConfig())
+    assert set(sentinel.summary()) == SDC_SUMMARY_KEYS
+    assert sentinel.summary()["quarantined_hosts"] == []

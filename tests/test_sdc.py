@@ -1,0 +1,374 @@
+"""Unit tier for the silent-data-corruption sentinel (sdc.py): the pure
+voting/flip/digest math, quarantine persistence, config validation, the
+chaos bit_flip wiring, and the decode canary's suppression discipline —
+all CPU-only and mesh-free (the collective protocol itself is `make
+sdc-smoke`'s job)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.sdc import (
+    DecodeCanary,
+    SDCConfig,
+    SDCSentinel,
+    flip_float32,
+    load_quarantine,
+    record_quarantine,
+    vote,
+)
+
+
+# ---------------------------------------------------------------------------
+# vote(): bit-wise majority with the no-majority probe fallback
+# ---------------------------------------------------------------------------
+
+
+def test_vote_all_agree():
+    v = vote([1.5, 1.5, 1.5, 1.5])
+    assert v["agree"] and v["has_majority"]
+    assert v["outliers"] == [] and v["majority_ranks"] == [0, 1, 2, 3]
+
+
+def test_vote_majority_names_the_outlier():
+    v = vote([2.0, 2.0, 7.0, 2.0])
+    assert not v["agree"] and v["has_majority"]
+    assert v["outliers"] == [2]
+    assert v["majority_ranks"] == [0, 1, 3]
+
+
+def test_vote_two_replica_split_has_no_majority():
+    # n=2 disagreement: counting cannot convict either side — every rank is
+    # an outlier and the caller falls back to the redundant-compute probe.
+    v = vote([1.0, 2.0])
+    assert not v["agree"] and not v["has_majority"]
+    assert v["outliers"] == [0, 1] and v["majority_ranks"] == []
+
+
+def test_vote_three_way_tie_has_no_majority():
+    v = vote([1.0, 2.0, 3.0])
+    assert not v["has_majority"] and v["outliers"] == [0, 1, 2]
+
+
+def test_vote_is_bitwise_not_approximate():
+    # One float32-ulp apart: numerically negligible, but silent corruption
+    # is exact or it isn't there — the vote must flag it.
+    base = 100.0
+    nudged = float(np.nextafter(np.float32(base), np.float32(np.inf)))
+    v = vote([base, base, nudged])
+    assert not v["agree"] and v["outliers"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# flip_float32(): finite, wrong, and reversible
+# ---------------------------------------------------------------------------
+
+
+def test_flip_float32_is_finite_wrong_and_involutive():
+    for value in (0.5, 123.456, -3.25, 1e30):
+        for bit in (0, 5, 22):
+            flipped = flip_float32(value, bit=bit)
+            assert np.isfinite(flipped), (value, bit)
+            assert flipped != float(np.float32(value)), (value, bit)
+            assert flip_float32(flipped, bit=bit) == float(np.float32(value))
+
+
+def test_flip_float32_survives_float32_transport():
+    # The allgather transport truncates to float32 (the whole reason the
+    # flip lives in float32 mantissa space): the corruption must still be
+    # visible after a float64 -> float32 -> float64 round trip.
+    value = 86.97010040283203
+    flipped = flip_float32(value, bit=5)
+    assert float(np.float32(flipped)) == flipped
+    assert np.float64(np.float32(flipped)).tobytes() != \
+        np.float64(np.float32(value)).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# integrity_digest(): leaf-order sensitivity
+# ---------------------------------------------------------------------------
+
+
+def test_integrity_digest_detects_leaf_swap():
+    a = np.full((4,), 2.0, np.float32)
+    b = np.full((4,), 5.0, np.float32)
+    d1 = float(np.asarray(_digest({"a": a, "b": b})))
+    d2 = float(np.asarray(_digest({"a": b, "b": a})))
+    assert np.isfinite(d1) and np.isfinite(d2)
+    # Plain unweighted abs-sums would cancel the swap; the per-leaf weights
+    # must not.
+    assert d1 != d2
+
+
+def test_integrity_digest_ignores_integer_leaves():
+    a = np.full((4,), 2.0, np.float32)
+    step = np.asarray(7, np.int32)
+    assert float(np.asarray(_digest({"a": a, "step": step}))) == \
+        float(np.asarray(_digest({"a": a, "step": step + 3})))
+
+
+def _digest(params):
+    from accelerate_tpu.sdc import integrity_digest
+
+    return integrity_digest(params, grad_norm=1.0)
+
+
+# ---------------------------------------------------------------------------
+# SDCConfig validation + kwargs arming
+# ---------------------------------------------------------------------------
+
+
+def test_sdc_config_validation():
+    assert SDCConfig().vote_every == 8
+    with pytest.raises(ValueError):
+        SDCConfig(vote_every=0)
+    with pytest.raises(ValueError):
+        SDCConfig(repair="reboot")
+    with pytest.raises(ValueError):
+        SDCConfig(probe="maybe")
+    with pytest.raises(ValueError):
+        SDCConfig(max_repairs=-1)
+    with pytest.raises(ValueError):
+        SDCConfig(bit=23)  # float32 mantissa bits are 0..22
+    with pytest.raises(ValueError):
+        SDCConfig(bit=-1)
+
+
+def test_fault_tolerance_kwargs_sdc_off_by_default():
+    from accelerate_tpu.utils import FaultToleranceKwargs
+
+    assert FaultToleranceKwargs().sdc is None
+    assert FaultToleranceKwargs(sdc=dict(vote_every=4)).sdc == {"vote_every": 4}
+    assert FaultToleranceKwargs(sdc=SDCConfig()).sdc.vote_every == 8
+    with pytest.raises(ValueError):
+        FaultToleranceKwargs(sdc="yes")
+
+
+# ---------------------------------------------------------------------------
+# Quarantine persistence
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_roundtrip_and_torn_record(tmp_path):
+    d = str(tmp_path)
+    assert load_quarantine(d) == {"hosts": []}
+    assert load_quarantine(None) == {"hosts": []}
+    entry = {"process_index": 3, "host": "tpu-worker-7", "step": 120,
+             "tick": 119, "reason": "probe reproduced", "time": 1.0}
+    rec = record_quarantine(d, entry)
+    assert rec["hosts"] == [entry]
+    record_quarantine(d, {**entry, "host": "tpu-worker-9"})
+    hosts = [h["host"] for h in load_quarantine(d)["hosts"]]
+    assert hosts == ["tpu-worker-7", "tpu-worker-9"]
+    # A torn record (partial JSON) must never block a relaunch.
+    with open(os.path.join(d, "sdc_quarantine.json"), "w") as f:
+        f.write('{"hosts": [{"ho')
+    assert load_quarantine(d) == {"hosts": []}
+
+
+def test_sentinel_loads_quarantine_from_prior_incarnations(tmp_path):
+    record_quarantine(str(tmp_path), {"host": "bad-host", "process_index": 1})
+
+    class _Acc:
+        project_dir = str(tmp_path)
+
+    class _Mgr:
+        accelerator = _Acc()
+
+    s = SDCSentinel(_Mgr(), SDCConfig())
+    assert s.summary()["quarantined_hosts"] == ["bad-host"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos wiring: the bit_flip kind and point-name-keyed draws
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_bit_flip_points_and_extras():
+    from accelerate_tpu.chaos import _POINT_KINDS, FAULT_KINDS, FaultInjector
+
+    assert "bit_flip" in FAULT_KINDS
+    assert "bit_flip" in _POINT_KINDS["train_step"]
+    assert "bit_flip" in _POINT_KINDS["decode_tick"]
+    inj = FaultInjector(seed=7, schedule=[
+        {"point": "train_step", "kind": "bit_flip", "tick": 4, "unit": 0,
+         "mode": "sticky", "bit": 9}])
+    assert inj.draw("train_step", tick=3) is None
+    f = inj.draw("train_step", tick=4, unit=0)
+    assert f is not None and f.kind == "bit_flip"
+    assert f.extra["mode"] == "sticky" and f.extra["bit"] == 9
+    assert inj.injected == [{"tick": 4, "point": "train_step",
+                             "kind": "bit_flip", "unit": 0}]
+    # One-shot: the schedule entry is spent.
+    assert inj.draw("train_step", tick=4, unit=0) is None
+
+
+def test_chaos_draws_are_point_name_keyed():
+    # Adding bit_flip rates at one point must not move another point's
+    # draws for the same seed — the u01 stream is (seed, point, tick, unit).
+    from accelerate_tpu.chaos import FaultInjector
+
+    base = FaultInjector(seed=11, rates={"train_step": {"slow_step": 0.3}})
+    both = FaultInjector(seed=11, rates={"train_step": {"slow_step": 0.3},
+                                         "decode_tick": {"bit_flip": 0.5}})
+    draws_a = [(f.kind if f else None)
+               for f in (base.draw("train_step", t) for t in range(64))]
+    draws_b = [(f.kind if f else None)
+               for f in (both.draw("train_step", t) for t in range(64))]
+    assert draws_a == draws_b
+
+
+def test_sentinel_note_bit_flip_modes():
+    class _Acc:
+        project_dir = None
+
+    class _Mgr:
+        accelerator = _Acc()
+
+    from accelerate_tpu.chaos import Fault
+
+    s = SDCSentinel(_Mgr(), SDCConfig())
+    s.note_bit_flip(Fault("train_step", "bit_flip", 4, 0, 0.1,
+                          {"mode": "transient"}))
+    assert s._flip is not None and not s._sticky
+    s.note_bit_flip(Fault("train_step", "bit_flip", 5, 0, 0.1,
+                          {"mode": "sticky"}))
+    assert s._sticky
+
+
+# ---------------------------------------------------------------------------
+# DecodeCanary: suppression discipline against a fake engine
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    """Just enough engine surface for the canary: a finished queue, a tick
+    counter, a journal slot, and a submit that records what the journal
+    looked like DURING the call."""
+
+    def __init__(self):
+        self._stats = {"ticks": 0}
+        self._finished = []
+        self._journal = "WAL"
+        self.decode_devices = ["cpu:4"]
+        self._next_id = 0
+        self.journal_during_submit = None
+        self.canary = None
+
+    def attach_sdc_canary(self, canary):
+        self.canary = canary
+
+    def submit(self, prompt, max_new_tokens=None, rng=None):
+        self.journal_during_submit = self._journal
+        rid = self._next_id
+        self._next_id += 1
+        return rid
+
+    def tick(self):
+        # Complete any inflight probe with a deterministic row, then run
+        # the end-of-tick canary hook like the real engine does.
+        self._stats["ticks"] += 1
+        c = self.canary
+        if c is not None and c._inflight is not None:
+            self._finished.append(
+                {"id": c._inflight, "status": "ok",
+                 "tokens": np.asarray([1, 2, 3, 9], np.int64)})
+        if c is not None:
+            c.on_tick()
+
+
+class _FakeAutoscaler:
+    def __init__(self):
+        self.dead = []
+
+    def mark_device_dead(self, dev):
+        self.dead.append(dev)
+
+
+def test_canary_warmup_arms_and_suppresses(tmp_path):
+    eng = _FakeEngine()
+    canary = DecodeCanary(eng, every=4)
+    assert eng.canary is canary  # attach hook ran
+    canary.warmup()
+    assert canary.armed and canary._golden == [1, 2, 3, 9]
+    assert canary.golden_digest is not None
+    # The probe row never lingers in the finished queue (poll-invisible)
+    # and the journal was detached exactly for the submit call.
+    assert eng._finished == []
+    assert eng.journal_during_submit is None
+    assert eng._journal == "WAL"
+    assert canary.probe_rids == [0]
+    # Warmup zeroes the counters: steady-state probes count from zero.
+    assert canary.summary()["probes"] == 0
+
+
+def test_canary_periodic_probe_and_mismatch_quarantine():
+    eng = _FakeEngine()
+    auto = _FakeAutoscaler()
+    canary = DecodeCanary(eng, every=4, autoscaler=auto)
+    canary.warmup()
+    for _ in range(8):
+        eng.tick()
+    s = canary.summary()
+    assert s["probes"] >= 1 and s["mismatches"] == 0 and auto.dead == []
+
+    # Corrupt the next probe's row: one flipped token = silent corruption.
+    def corrupt_tick():
+        eng._stats["ticks"] += 1
+        if canary._inflight is not None:
+            eng._finished.append(
+                {"id": canary._inflight, "status": "ok",
+                 "tokens": np.asarray([1, 2, 3, 8], np.int64)})
+        canary.on_tick()
+
+    while canary._inflight is None:
+        eng.tick()  # advance until a probe is submitted
+    corrupt_tick()
+    s = canary.summary()
+    assert s["mismatches"] == 1 and s["quarantines"] == 1
+    assert auto.dead == ["cpu:4"]
+    assert s["suppressed_rows"] == s["probes"]
+
+
+def test_canary_rejects_empty_prompt():
+    with pytest.raises(ValueError):
+        DecodeCanary(_FakeEngine(), prompt=np.zeros((0,), np.int32))
+
+
+def test_canary_reset_counters_keeps_golden():
+    eng = _FakeEngine()
+    canary = DecodeCanary(eng, every=4)
+    canary.warmup()
+    for _ in range(8):
+        eng.tick()
+    assert canary.summary()["probes"] >= 1
+    canary.reset_counters()
+    s = canary.summary()
+    assert s["probes"] == 0 and s["armed"] is True
+    assert s["golden_digest"] == canary.golden_digest
+
+
+# ---------------------------------------------------------------------------
+# Exit-code protocol
+# ---------------------------------------------------------------------------
+
+
+def test_sdc_exit_code_in_protocol_table():
+    from accelerate_tpu.utils.constants import (
+        EXIT_CODE_TABLE,
+        PROTOCOL_EXIT_CLASSES,
+        SDC_EXIT_CODE,
+    )
+
+    assert SDC_EXIT_CODE == 79
+    assert PROTOCOL_EXIT_CLASSES[SDC_EXIT_CODE] == "sdc"
+    row = next(r for r in EXIT_CODE_TABLE if r["code"] == SDC_EXIT_CODE)
+    assert "SHRUNK" in row["response"]
+
+
+def test_quarantine_file_is_json_on_disk(tmp_path):
+    record_quarantine(str(tmp_path), {"host": "h1"})
+    with open(os.path.join(str(tmp_path), "sdc_quarantine.json")) as f:
+        assert json.load(f)["hosts"] == [{"host": "h1"}]
