@@ -8,7 +8,8 @@ PYTEST = $(ENV) python -m pytest -q
         test_cli test_examples test_checkpointing test_hub test_tpu quality bench \
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
         reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
-        autoscale-smoke trace-smoke gameday-smoke sdc-smoke smoke-all
+        autoscale-smoke trace-smoke gameday-smoke sdc-smoke profile-smoke \
+        smoke-all
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -222,11 +223,21 @@ reshard-smoke:
 sdc-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.sdc_smoke
 
+# Device-time attribution + flight-recorder gate: a dp-sharded train run
+# and a chaos-seeded serving replay with the profiler on must emit
+# exactly-summing attribution terms (5% bar), an overlap ratio, per-axis
+# bandwidth residuals, and a flat jit cache; a hard-killed child (rc 78)
+# and an SDC-convicted gang rank (rc 79) must each leave a readable
+# flight_<exit_class>.json whose newest ring entries identify the dying
+# tick/step. See docs/usage_guides/observability.md.
+profile-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.profile_smoke
+
 # Every acceptance gate back to back with a one-line pass/fail table and a
 # nonzero exit if any gate failed. Serial on purpose: the gates share the
 # CPU cores and several launch their own subprocess gangs.
 SMOKES = telemetry warmup serving plan reshard disagg chaos chaos-train \
-         publish autoscale trace faulttol gameday sdc
+         publish autoscale trace faulttol gameday sdc profile
 smoke-all:
 	@fail=0; \
 	for s in $(SMOKES); do \

@@ -92,6 +92,12 @@ from .autoscale import (  # noqa: E402
     make_diurnal_trace,
 )
 from .chaos import Fault, FaultInjector, InjectedFaultError, flush_injected_log  # noqa: E402
+from .profiler import (  # noqa: E402
+    DeviceTimeProfiler,
+    FlightRecorder,
+    MetricsHub,
+    ProfilerConfig,
+)
 from .tracing import TraceConfig, TraceRecorder  # noqa: E402
 from .utils.dataclasses import (  # noqa: E402
     AutoPlanKwargs,

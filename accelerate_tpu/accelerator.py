@@ -1677,6 +1677,12 @@ class Accelerator:
                 # save_state, Model.__call__ and trackers must see the new one.
                 self._train_states[slot] = new_state
                 return self._maybe_sentinel(new_state, metrics, slot), metrics
+            if tel.profiler is not None:
+                # One-time AOT cost_analysis capture (flops + bytes) BEFORE
+                # the step call, while the pre-donation buffers are live —
+                # the same slot sdc.capture_golden uses. Leaves the jit
+                # dispatch cache untouched (flat-cache invariant).
+                tel.profiler.capture_cost(jitted, state, batch)
             t0 = time.perf_counter()
             new_state, metrics = jitted(state, batch)
             if tel.handler.sync_timing:
@@ -2007,6 +2013,11 @@ class Accelerator:
             if cm is not None:
                 cm.observe(batch)
             tel = self.telemetry
+            if tel is not None and tel.profiler is not None:
+                # Same one-time cost capture as the fused path; the comm
+                # hook threads its state as a third traced argument.
+                tel.profiler.capture_cost(
+                    jitted, state, batch, holder["comm_state"])
             t0 = time.perf_counter() if tel is not None else 0.0
             new_state, metrics, holder["comm_state"] = jitted(
                 state, batch, holder["comm_state"]

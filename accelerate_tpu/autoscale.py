@@ -513,9 +513,12 @@ class AutoscaleController:
             self.tracing.instant(
                 "autoscale", f"autoscale_{action}", tick, signal=signal,
                 reason=reason, active_devices=rec["active_devices"])
+        # Decisions already land in telemetry records and trace instants;
+        # under flapping load this fires every few ticks, so keep it at
+        # debug rather than spamming INFO on the serving hot path.
         if _log_ok() and action != "hold":
-            logger.info("autoscale: tick %d %s (%s — %s)", tick, action,
-                        signal, reason)
+            logger.debug("autoscale: tick %d %s (%s — %s)", tick, action,
+                         signal, reason)
         if self.telemetry is not None:
             try:
                 self.telemetry.record_event(

@@ -289,6 +289,20 @@ def flush_injected_log(injector, telemetry) -> None:
                 )
             except Exception:  # pragma: no cover - dying anyway
                 logger.exception("chaos: injected-log flush failed")
+            prof = getattr(telemetry, "profiler", None)
+            if prof is not None:
+                # The flight bundle (profiler.py) carries the fault
+                # schedule that killed the run next to the last attribution
+                # records — the dump itself happens at the exit site.
+                try:
+                    prof.note_gauge("chaos", {
+                        "seed": injector.seed,
+                        "injected": injector.summary().get("injected"),
+                        "last": (list(injector.injected)[-3:]
+                                 if injector.injected else []),
+                    })
+                except Exception:  # pragma: no cover - dying anyway
+                    pass
         try:
             telemetry.close()
         except Exception:  # pragma: no cover - dying anyway

@@ -343,8 +343,11 @@ def launch_command(args: argparse.Namespace) -> int:
     attempt = 0
     while True:
         started = time.monotonic()
+        started_wall = time.time()
         rc = _run_gang(cmd, base_env, cfg, port, monitor_interval, attempt)
         decision = supervisor.decide(rc, time.monotonic() - started, cfg.num_processes)
+        if rc != 0:
+            _surface_flight_bundles(started_wall, attempt)
         left = max_restarts - supervisor.restarts_used
         if decision.action == "stop":
             if decision.reason:
@@ -404,6 +407,38 @@ def launch_command(args: argparse.Namespace) -> int:
             time.sleep(decision.delay_s)
         port = None  # re-draw a fresh port next attempt
         attempt += 1
+
+
+def _surface_flight_bundles(started_wall: float, attempt: int) -> None:
+    """After an abnormal gang exit, point the operator at any crash flight
+    bundle a child wrote during this attempt (profiler.FlightRecorder dumps
+    ``flight_<exit_class>.json`` on its way down). Only bundles newer than
+    the attempt's start count — stale bundles from earlier runs stay quiet."""
+    try:
+        from ..profiler import find_flight_bundles
+    except Exception:
+        return
+    import json
+
+    for path in find_flight_bundles():
+        try:
+            if os.path.getmtime(path) < started_wall - 1.0:
+                continue
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError):
+            continue
+        ring = bundle.get("entries") or []
+        tail = ring[-3:]
+        print(
+            f"[accelerate-tpu] attempt {attempt}: flight recorder bundle at "
+            f"{path} (exit_class={bundle.get('exit_class')}, "
+            f"reason={bundle.get('reason')!r}, {len(ring)} ring entries)",
+            file=sys.stderr,
+        )
+        for entry in tail:
+            print(f"[accelerate-tpu]   last: {json.dumps(entry, default=str)}",
+                  file=sys.stderr)
 
 
 def _run_gang(cmd, base_env, cfg, port, monitor_interval: float, attempt: int) -> int:

@@ -153,7 +153,8 @@ class DisaggServingEngine(ServingEngine):
 
     def __init__(self, model, config=None, *, disagg=None, devices=None,
                  forward_cached=None, compile_manager=None, telemetry=None,
-                 fault_tolerance=None, chaos=None, tracing=None, journal=None):
+                 fault_tolerance=None, chaos=None, tracing=None, journal=None,
+                 profiler=None):
         from .utils.dataclasses import DisaggConfig
 
         self.disagg_config = disagg if disagg is not None else DisaggConfig()
@@ -168,7 +169,7 @@ class DisaggServingEngine(ServingEngine):
         super().__init__(model, config, forward_cached=forward_cached,
                          compile_manager=compile_manager, telemetry=telemetry,
                          fault_tolerance=fault_tolerance, chaos=chaos,
-                         tracing=tracing, journal=journal)
+                         tracing=tracing, journal=journal, profiler=profiler)
         dc = self.disagg_config
         # Degradation state: quarantined lanes leave the pool for good; once
         # EVERY lane is gone the engine latches degraded and prefills
@@ -350,12 +351,16 @@ class DisaggServingEngine(ServingEngine):
         (disjoint devices — the chunks run concurrently), then one decode
         step on the decode mesh. Degraded mode (every lane quarantined)
         prefills head-of-line colocated on the decode mesh instead."""
+        prof = self._profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
+        tick_no = self._stats["ticks"]
         snap = self._begin_tick()
         self._admit()
         self._sample_queue_depth()
         self._drain_handoffs()
         if not self._degraded:
             self._assign_lanes()
+        t1 = time.perf_counter() if prof is not None else 0.0
         for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
             if self._degraded:
                 # Colocated fallback: the base head-of-line discipline, the
@@ -369,10 +374,35 @@ class DisaggServingEngine(ServingEngine):
                     break
                 for req in runnable:
                     self._prefill_one(req)
+        t2 = time.perf_counter() if prof is not None else 0.0
+        self._tick_fetch_s = 0.0  # filled by _decode_tick's device_get timer
         if self._decoding:
             self._decode_tick()
         self._drain_decode_tick()
+        t3 = time.perf_counter() if prof is not None else 0.0
         self._end_tick(snap)
+        if prof is not None:
+            # Same lagged per-tick attribution as the colocated engine's
+            # tick (serving.py): host perf_counter sections only, the
+            # bookkeeping residual closes the identity. admit_s absorbs the
+            # router-only phases (handoff drain + lane assignment).
+            t4 = time.perf_counter()
+            prof.on_tick(
+                tick_no, t4 - t0,
+                sections={
+                    "admit_s": t1 - t0,
+                    "prefill_s": t2 - t1,
+                    "decode_s": (t3 - t2) - self._tick_fetch_s,
+                    "host_fetch_s": self._tick_fetch_s,
+                    "bookkeeping_s": t4 - t3,
+                },
+                gauges={
+                    "journal_lsn": (self._journal.stats()["appends"]
+                                    if self._journal is not None else None),
+                    "jit_cache": self.executable_counts(),
+                    "occupancy": len(self._decoding),
+                },
+            )
 
     def _assign_lanes(self) -> None:
         """Hand free lanes to lane-less prefilling requests, health-checking

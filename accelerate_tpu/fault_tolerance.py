@@ -529,6 +529,13 @@ class StepWatchdog:
                     "with no progress after self-preempt — hard exit %d.",
                     self.grace_s, TRAINING_STALLED_EXIT_CODE,
                 )
+                from .profiler import dump_flight
+
+                dump_flight(
+                    getattr(self.manager.accelerator, "telemetry", None),
+                    TRAINING_STALLED_EXIT_CODE,
+                    reason=f"watchdog grace expired after self-preempt "
+                           f"(no progress for {age:.2f}s)")
                 self.manager.flush_telemetry()
                 os._exit(TRAINING_STALLED_EXIT_CODE)
 
@@ -671,11 +678,16 @@ class FaultToleranceManager:
                 "fault_tolerance: injected dead_host — exiting %d "
                 "(tick %d, rank %d).", code, tick, rank,
             )
-            # os._exit skips every atexit/finally, so the injector's full
-            # injected log must reach the telemetry stream here or the
+            # os._exit skips every atexit/finally, so the flight ring and
+            # the injector's full injected log must reach disk here or the
             # post-mortem loses the fault schedule that killed the run.
+            from .profiler import dump_flight
+
             flush_injected_log(
                 self.chaos, getattr(self.accelerator, "telemetry", None))
+            dump_flight(getattr(self.accelerator, "telemetry", None), code,
+                        reason=f"injected dead_host on rank {rank} at "
+                               f"tick {tick}")
             os._exit(code)
         poison = False
         f = self.chaos.draw("train_step", tick, unit=rank)

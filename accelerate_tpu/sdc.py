@@ -331,6 +331,12 @@ class SDCSentinel:
             "digests": 0, "votes": 0, "mismatches": 0,
             "probes": 0, "probes_failed": 0, "repairs": 0, "quarantines": 0,
         }
+        hub = getattr(getattr(manager.accelerator, "telemetry", None),
+                      "hub", None)
+        if hub is not None:
+            # The sentinel's tallies on the unified metrics registry
+            # (accelerate_tpu_sdc_* gauges — profiler.py MetricsHub).
+            hub.register_provider("sdc", self.summary, replace=True)
         # Quarantine record from previous incarnations of this run: the
         # supervisor already shrank past the convicted hosts, this is the
         # persisted audit trail (and what the smoke pins across relaunch).
@@ -539,11 +545,18 @@ class SDCSentinel:
             "%d for a shrunk relaunch.", entry["process_index"],
             entry["host"], SDC_EXIT_CODE)
         self.manager._event("sdc_quarantine", **entry)
-        # os._exit skips every atexit/finally: the injector's schedule and
-        # the telemetry summary must reach disk here or the post-mortem
-        # loses them (same discipline as dead_host / engine_crash).
+        # os._exit skips every atexit/finally: the flight ring, the
+        # injector's schedule, and the telemetry summary must reach disk
+        # here or the post-mortem loses them (same discipline as
+        # dead_host / engine_crash).
+        from .profiler import dump_flight
+
         flush_injected_log(
             self.manager.chaos, getattr(acc, "telemetry", None))
+        dump_flight(getattr(acc, "telemetry", None), SDC_EXIT_CODE,
+                    reason=f"sticky SDC conviction on rank "
+                           f"{entry['process_index']} at step "
+                           f"{entry['step']}")
         os._exit(SDC_EXIT_CODE)
 
     # -- reporting ---------------------------------------------------------

@@ -450,7 +450,7 @@ class ServingEngine:
 
     def __init__(self, model, config=None, *, forward_cached: Optional[Callable] = None,
                  compile_manager=None, telemetry=None, fault_tolerance=None,
-                 chaos=None, tracing=None, journal=None):
+                 chaos=None, tracing=None, journal=None, profiler=None):
         from .utils.dataclasses import ServingConfig
 
         self.config = config if config is not None else ServingConfig()
@@ -464,6 +464,13 @@ class ServingEngine:
         # ``is None`` check, same zero-cost contract as telemetry/chaos.
         self.tracing = tracing if tracing is not None else getattr(
             telemetry, "tracing", None)
+        # Device-time attribution (profiler.py DeviceTimeProfiler): ticks
+        # feed lagged per-tick term records (admit/prefill/decode/fetch +
+        # the bookkeeping residual) from host perf_counter sections — no
+        # extra device syncs. Defaults to the telemetry recorder's
+        # profiler (TelemetryKwargs(profile=...)); same None contract.
+        self._profiler = profiler if profiler is not None else getattr(
+            telemetry, "profiler", None)
         # Crash-durable request journal (journal.py): ``journal=`` takes a
         # RequestJournal or a directory path; ``ServingConfig.journal_dir``
         # is the config-only spelling. None (the default everywhere) keeps
@@ -607,14 +614,30 @@ class ServingEngine:
         self._poison_op = None       # lazily jitted chaos-only program
         self._draining = False
         self._idle_ticks = 0
+        # Per-tick fused-fetch wall accumulator (profiler host_fetch_s
+        # term); reset by tick(), accumulated by _decode_tick (which the
+        # disagg router also calls — the attribute must always exist).
+        self._tick_fetch_s = 0.0
         # Decode canary (sdc.py DecodeCanary): attached via
         # attach_sdc_canary(); every tick-end hook is a single None check.
         self._sdc_canary = None
         self._has_deadlines = self.config.deadline_s is not None
         if self.tracing is not None:
             # metrics_text() parity: the Prometheus snapshot reads the same
-            # live stats() dict external callers see.
+            # live stats() dict external callers see. register_gauges now
+            # delegates to the unified MetricsHub (profiler.py) — one
+            # renderer, one naming scheme across every exporter.
             self.tracing.register_gauges("serving", self.stats)
+        # SLO burn-rate window on the hub: every terminal request feeds one
+        # good/bad sample; the renderer exposes the burn rate and the
+        # watchdog warns (once) on sustained budget overspend.
+        self._hub = getattr(self.tracing, "hub", None) or getattr(
+            telemetry, "hub", None)
+        if self._hub is not None:
+            self._hub.register_slo("serving_availability", 0.99)
+            if self._journal is not None:
+                self._hub.register_provider(
+                    "journal", self._journal.stats, replace=True)
 
     @property
     def chaos(self):
@@ -776,16 +799,44 @@ class ServingEngine:
         slot. Raises :class:`ServingStalledError` via the hang guard if
         ``max_idle_ticks`` rounds pass with pending requests and zero
         progress."""
+        prof = self._profiler
+        t0 = time.perf_counter() if prof is not None else 0.0
+        tick_no = self._stats["ticks"]
         snap = self._begin_tick()
         self._admit()
         self._sample_queue_depth()
+        t1 = time.perf_counter() if prof is not None else 0.0
         for _ in range(max(1, int(self.config.prefill_chunks_per_tick))):
             if not self._prefilling:
                 break
             self._prefill_one(self._prefilling[0])
+        t2 = time.perf_counter() if prof is not None else 0.0
+        self._tick_fetch_s = 0.0  # filled by _decode_tick's device_get timer
         if self._decoding:
             self._decode_tick()
+        t3 = time.perf_counter() if prof is not None else 0.0
         self._end_tick(snap)
+        if prof is not None:
+            # Lagged per-tick attribution: host perf_counter sections only
+            # (the fused device_get is already the tick's one host sync —
+            # the profiler adds none). bookkeeping_s closes the identity.
+            t4 = time.perf_counter()
+            prof.on_tick(
+                tick_no, t4 - t0,
+                sections={
+                    "admit_s": t1 - t0,
+                    "prefill_s": t2 - t1,
+                    "decode_s": (t3 - t2) - self._tick_fetch_s,
+                    "host_fetch_s": self._tick_fetch_s,
+                    "bookkeeping_s": t4 - t3,
+                },
+                gauges={
+                    "journal_lsn": (self._journal.stats()["appends"]
+                                    if self._journal is not None else None),
+                    "jit_cache": self.executable_counts(),
+                    "occupancy": len(self._decoding),
+                },
+            )
 
     # -- robustness plumbing (shared with the disagg router's tick) --------
 
@@ -1052,9 +1103,15 @@ class ServingEngine:
             # The per-tick host sync: fetch this round's tokens + done flags
             # + the nonfinite sentinel (one fused device_get — no extra
             # stall). Under a mixed-version tick this runs once per group,
-            # reading only the rows that group's mask advanced.
+            # reading only the rows that group's mask advanced. The
+            # profiler times THIS existing sync (it never adds one): the
+            # fetch wall is the tick's host_fetch_s attribution term.
+            if self._profiler is not None:
+                tf0 = time.perf_counter()
             tok_np, done_np, bad_np = jax.device_get(
                 (tok, self._state.done, bad))
+            if self._profiler is not None:
+                self._tick_fetch_s += time.perf_counter() - tf0
             if flip_slot is not None and mask[flip_slot]:
                 tok_np = np.array(tok_np)
                 tok_np[flip_slot] ^= 1
@@ -1131,6 +1188,11 @@ class ServingEngine:
             "status": status, "ttft_s": ttft, "tpot_s": tpot,
             "prompt_tokens": int(req.tokens.size), "new_tokens": n_new,
         })
+        if self._hub is not None:
+            # One good/bad sample per terminal request into the hub's SLO
+            # rolling window ("shed" during a preemption drain still counts
+            # against availability — the client saw a non-answer).
+            self._hub.observe_slo("serving_availability", status == "ok")
         if req.canary and req.weights_version in self._cohorts:
             self._cohorts[req.weights_version]["events"].append({
                 "status": status, "ttft_s": ttft, "tpot_s": tpot,
@@ -1313,9 +1375,11 @@ class ServingEngine:
     def _hard_crash(self, fault) -> None:
         """An injected ``engine_crash``: die like a real serving-process
         death — no drain, no journal seal (what the fsync policy promised
-        durable is the contract under test) — after flushing telemetry and
-        the injector's log so the post-mortem schedule is never torn."""
+        durable is the contract under test) — after dumping the flight
+        ring and flushing telemetry + the injector's log so the
+        post-mortem schedule is never torn."""
         from .chaos import flush_injected_log
+        from .profiler import dump_flight
 
         code = int((fault.extra or {}).get(
             "exit_code", SERVING_CRASH_EXIT_CODE))
@@ -1337,6 +1401,12 @@ class ServingEngine:
             except Exception:  # pragma: no cover - dying anyway
                 pass
         flush_injected_log(self._chaos, self.telemetry)
+        # Flight dump LAST: the flush above folded the injector's schedule
+        # into the ring's gauges and finalized the lagged tick record, so
+        # the bundle's newest entries identify the tick that was dying.
+        dump_flight(self._profiler, code,
+                    reason=f"injected engine_crash at tick "
+                           f"{self._stats['ticks']}")
         os._exit(code)
 
     def recover(self, journal_dir: Optional[str] = None) -> dict:
@@ -1371,6 +1441,9 @@ class ServingEngine:
                 segment_records=self.config.journal_segment_records,
             )
             self._journal.chaos = self._chaos
+            if self._hub is not None:
+                self._hub.register_provider(
+                    "journal", self._journal.stats, replace=True)
         if self._journal is None:
             raise ValueError(
                 "recover() needs a journal: pass journal_dir=, set "
@@ -1635,8 +1708,10 @@ class ServingEngine:
         self._weights_version = v
         self._params = self._params_by_version[v]
         self._gc_versions()
+        # Per-publish event; the publisher already logs the bind at INFO,
+        # so the engine-side echo stays at debug.
         if _log_ok():
-            logger.info("serving: params swapped to version %d", v)
+            logger.debug("serving: params swapped to version %d", v)
 
     def begin_canary(self, params, *, weights_version: int,
                      fraction: float = 0.1) -> None:
@@ -1867,6 +1942,11 @@ class ServingEngine:
             # The trace restarts with the metrics: warmup spans would
             # otherwise pollute explain()/the tick-domain replay invariant.
             self.tracing.reset()
+        if self._profiler is not None:
+            # Warmup attribution records would skew the term means and the
+            # flight ring; the captured cost/plan pricing survives (it
+            # fingerprints the program, not the run).
+            self._profiler.reset()
         if self._sdc_canary is not None:
             # Probe counters restart with the metrics; the golden row stays
             # armed (it fingerprints the weights, not the run).

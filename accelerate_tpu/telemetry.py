@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from .logging import get_logger
+from .profiler import DeviceTimeProfiler, MetricsHub, ProfilerConfig
 from .tracing import TraceConfig, TraceRecorder
 from .utils.memory import get_device_memory_stats, live_bytes_on_device
 from .utils.operations import collective_counters, gather
@@ -201,10 +202,27 @@ class TelemetryRecorder:
         # accelerator pick it up from here, and summary() grows a
         # "tracing" block. None when off — same zero-cost contract as
         # every other hook in this file.
+        # The unified metrics registry (profiler.py MetricsHub): tracing,
+        # serving, autoscale, publish, journal, and the SDC sentinel all
+        # register providers here; one renderer, one naming scheme.
+        self.hub = MetricsHub()
+        self.hub.register_provider("telemetry", self._hub_stats)
         self.tracing = None
         tr_cfg = TraceConfig.from_value(getattr(handler, "tracing", None))
         if tr_cfg is not None:
-            self.tracing = TraceRecorder(tr_cfg)
+            self.tracing = TraceRecorder(tr_cfg, hub=self.hub)
+        # Device-time attribution (profiler.py): built from the handler's
+        # ``profile`` knob; lagged one step — zero extra device syncs.
+        # summary() grows a "profile" block and abnormal exits dump the
+        # profiler's flight ring. Same zero-cost None contract when off.
+        self.profiler = None
+        pf_cfg = ProfilerConfig.from_value(getattr(handler, "profile", None))
+        if pf_cfg is not None:
+            self.profiler = DeviceTimeProfiler(
+                pf_cfg, out_dir=accelerator.project_dir or ".")
+            self.hub.register_provider("profile", self.profiler.summary)
+            if self.tracing is not None:
+                self.profiler.flight.attach_tracing(self.tracing)
         # JSONL rotation state (handler.max_log_bytes): one warning on the
         # first rotation, then silent.
         self._rotated_once = False
@@ -254,6 +272,12 @@ class TelemetryRecorder:
             "recompiles": self.recompiles,
         }
         record.update(self._memory_gauges())
+        if self.profiler is not None:
+            # Lagged attribution: this call finalizes step N-1's record and
+            # stashes step N — host arithmetic only, zero device syncs.
+            self.profiler.on_step(self.step, wall_s, data_wait)
+            self.profiler.note_gauge("hbm_peak_bytes", self._peak_hbm)
+            self.profiler.note_gauge("recompiles", self.recompiles)
         if metrics is not None and self.handler.sync_timing:
             # Only in sync mode: fetching the loss would otherwise force the
             # very host sync non-blocking timing exists to avoid.
@@ -439,6 +463,10 @@ class TelemetryRecorder:
         t_max, t_min = float(times.max()), float(times.min())
         mean = float(times.mean()) or 1e-12
         skew = (t_max - t_min) / mean
+        if self.profiler is not None:
+            # Absolute skew seconds land on the NEXT finalized step's
+            # attribution record (the probe runs after the step it sampled).
+            self.profiler.note_straggler(t_max - t_min)
         self._write(
             {
                 "event": "straggler_probe",
@@ -534,6 +562,10 @@ class TelemetryRecorder:
         self._plan = dict(plan)
         self._plan_path = path
         self._plan_calibrate_after = int(calibrate_after)
+        if self.profiler is not None:
+            # The plan's CostBreakdown + BandwidthTable price the
+            # profiler's per-axis comm terms and bandwidth residuals.
+            self.profiler.note_plan(self._plan)
         self._write({
             "event": "plan",
             "step": self.step,
@@ -820,6 +852,11 @@ class TelemetryRecorder:
             # Tracing block (tracing.py): span/request/flow census — the
             # aggregate face of the per-request span machinery.
             out["tracing"] = self.tracing.stats()
+        if self.profiler is not None:
+            # Device-time attribution block (profiler.py): term means,
+            # measured comm/compute overlap ratio, per-axis bandwidth
+            # residuals against the BandwidthTable, flight-ring census.
+            out["profile"] = self.profiler.summary()
         # Executable census: total dispatch-cache size across the watched
         # jitted fns — the number shape bucketing caps at len(buckets).
         sizes = [e["cache_size"] for e in self._watch.values() if e["cache_size"]]
@@ -841,10 +878,25 @@ class TelemetryRecorder:
             )
         return out
 
+    def _hub_stats(self) -> dict:
+        """The cheap scalar face of this recorder for the MetricsHub's
+        Prometheus rendering (``accelerate_tpu_telemetry_*``) — deliberately
+        NOT summary(), which walks percentiles on every call."""
+        return {
+            "steps": self.step,
+            "recompiles": self.recompiles,
+            "peak_hbm_bytes": self._peak_hbm or 0,
+            "checkpoint_events": self._checkpoint_events,
+        }
+
     def close(self):
         # A short run that never reached calibrate_after still calibrates on
         # the way out — partial measurements beat none for the next launch.
         self._maybe_calibrate_plan(final=True)
+        if self.profiler is not None:
+            # Finalize the lagged attribution records so the summary (and
+            # any flight dump after this point) covers the last step/tick.
+            self.profiler.flush()
         if self._fh is not None:
             self._write({"event": "summary", "time": time.time(), **self.summary()})
             self._fh.close()

@@ -228,7 +228,7 @@ class TraceRecorder:
       ``on_fault`` so injections annotate the span they hit.
     """
 
-    def __init__(self, config: Optional[TraceConfig] = None):
+    def __init__(self, config: Optional[TraceConfig] = None, *, hub=None):
         self.config = config or TraceConfig()
         self._spans: List[Span] = []
         self._seq = 0
@@ -250,10 +250,21 @@ class TraceRecorder:
         # or decode-tick span the fault manifests as).
         self._pending_fault: Optional[Dict[str, Any]] = None
         self._chaos_seed: Optional[int] = None
-        # Prometheus gauge providers: subsystem -> zero-arg callable
-        # returning a (possibly nested) dict of scalars.
-        self._gauges: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self._counts: Dict[str, int] = {}
+        # Prometheus exposition now lives on the unified MetricsHub
+        # (profiler.py): one renderer, one naming scheme. The recorder
+        # registers its own stats as the "tracing" provider plus a legacy
+        # text block keeping the pre-hub accelerate_tpu_trace_* names as
+        # aliases for one release.
+        from .profiler import MetricsHub
+
+        self.hub = hub if hub is not None else MetricsHub()
+        self.hub.register_provider("tracing", self.stats, replace=True)
+        self.hub.register_text(self._span_metric_lines)
+        self.hub.alias("accelerate_tpu_trace_dropped_spans_total",
+                       "accelerate_tpu_tracing_dropped_spans")
+        self.hub.alias("accelerate_tpu_trace_requests",
+                       "accelerate_tpu_tracing_requests")
 
     # ------------------------------------------------------------------
     # span plumbing
@@ -782,7 +793,7 @@ class TraceRecorder:
         return path
 
     # ------------------------------------------------------------------
-    # consumer 3: Prometheus text exposition
+    # consumer 3: Prometheus text exposition (delegated to the MetricsHub)
     # ------------------------------------------------------------------
     def register_gauges(self, subsystem: str,
                         provider: Callable[[], Dict[str, Any]]) -> None:
@@ -790,49 +801,41 @@ class TraceRecorder:
 
         numeric leaves are exposed by :meth:`metrics_text` as
         ``accelerate_tpu_<subsystem>_<path>`` gauges — same numbers as
-        ``stats()``/``window_stats()``, scraper-friendly format.
+        ``stats()``/``window_stats()``, scraper-friendly format. Delegates
+        to :meth:`MetricsHub.register_provider` (the single registry);
+        last registration wins, preserving the pre-hub semantics for
+        engines that replace a predecessor in the same process.
         """
-        self._gauges[subsystem] = provider
+        self.hub.register_provider(subsystem, provider, replace=True)
 
     @staticmethod
     def _sanitize(name: str) -> str:
         return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
-    def metrics_text(self) -> str:
-        lines: List[str] = []
-
-        def emit(name: str, value: Any) -> None:
-            if isinstance(value, bool):
-                value = int(value)
-            if isinstance(value, (int, float)) and value == value:  # no NaN
-                lines.append(f"{name} {value}")
-
-        def walk(prefix: str, obj: Any) -> None:
-            if isinstance(obj, dict):
-                for key in sorted(obj):
-                    walk(f"{prefix}_{self._sanitize(str(key))}", obj[key])
-            elif isinstance(obj, (int, float, bool)):
-                emit(prefix, obj)
-
-        lines.append("# HELP accelerate_tpu_trace_spans_total spans recorded by kind")
-        lines.append("# TYPE accelerate_tpu_trace_spans_total counter")
+    def _span_metric_lines(self) -> List[str]:
+        """Per-kind span counters for the hub renderer: the canonical
+        ``accelerate_tpu_tracing_spans_total{kind=...}`` series plus the
+        pre-hub ``accelerate_tpu_trace_spans_total`` spelling, kept as an
+        alias for one release (the hub's alias warning covers it)."""
+        lines = [
+            "# HELP accelerate_tpu_tracing_spans_total spans recorded by kind",
+            "# TYPE accelerate_tpu_tracing_spans_total counter",
+        ]
+        for kind in sorted(self._counts):
+            lines.append(
+                f'accelerate_tpu_tracing_spans_total{{kind="{self._sanitize(kind)}"}} '
+                f"{self._counts[kind]}")
         for kind in sorted(self._counts):
             lines.append(
                 f'accelerate_tpu_trace_spans_total{{kind="{self._sanitize(kind)}"}} '
                 f"{self._counts[kind]}")
-        emit("accelerate_tpu_trace_dropped_spans_total", self._dropped)
-        emit("accelerate_tpu_trace_requests", len(self._requests))
-        for subsystem in sorted(self._gauges):
-            try:
-                snapshot = self._gauges[subsystem]()
-            except Exception:
-                logger.exception("gauge provider %r failed", subsystem)
-                continue
-            lines.append(f"# HELP accelerate_tpu_{subsystem} live gauges "
-                         f"from {subsystem}.stats()")
-            lines.append(f"# TYPE accelerate_tpu_{subsystem} gauge")
-            walk(f"accelerate_tpu_{self._sanitize(subsystem)}", snapshot)
-        return "\n".join(lines) + "\n"
+        return lines
+
+    def metrics_text(self) -> str:
+        """Prometheus snapshot — now rendered by the unified
+        :class:`~accelerate_tpu.profiler.MetricsHub` (``self.hub``), so
+        every exporter shares one renderer and one naming scheme."""
+        return self.hub.render()
 
     # ------------------------------------------------------------------
     # deterministic projection + bookkeeping

@@ -143,3 +143,12 @@ PROTOCOL_EXIT_CLASSES = {
 # sticky-SDC probe convicts this host's silicon, read back by the next
 # launch so the exclusion survives gang restarts.
 SDC_QUARANTINE_FILE = "sdc_quarantine.json"
+
+# Crash flight bundle (profiler.py FlightRecorder): the last-N-records ring
+# dumped by every deliberate abnormal exit, named by the exit's
+# EXIT_CODE_TABLE classification (flight_serving-crash.json, flight_sdc.json,
+# ...). Written to $ACCELERATE_FLIGHT_DIR when set (the supervisor and its
+# children agree on the env var), else the dying process's project dir/cwd —
+# commands/launch.py surfaces the newest bundle after an abnormal child exit.
+FLIGHT_RECORD_PATTERN = "flight_{exit_class}.json"
+FLIGHT_DIR_ENV = "ACCELERATE_FLIGHT_DIR"

@@ -293,6 +293,15 @@ class TelemetryKwargs(KwargsHandler):
       accelerator inherit it, and ``summary()`` gains a ``"tracing"``
       block. Off (None) means zero cost: every hook is one ``is None``
       check.
+    - ``profile``: device-time attribution (profiler.py). ``True``
+      (default :class:`~accelerate_tpu.profiler.ProfilerConfig`), a dict
+      of field overrides, or a ``ProfilerConfig``. The profiler lands on
+      ``telemetry.profiler``, ``summary()`` gains a ``"profile"`` block
+      (exactly-summing per-step terms, comm/compute overlap ratio,
+      BandwidthTable residuals), and abnormal exits dump its flight ring
+      as ``flight_<exit_class>.json``. Attribution is lagged one step —
+      zero extra device syncs; off (None) is the same zero-cost contract
+      as ``tracing``.
     """
 
     enabled: bool = True
@@ -305,6 +314,7 @@ class TelemetryKwargs(KwargsHandler):
     output_dir: Optional[str] = None
     max_log_bytes: Optional[int] = 256 * 1024 * 1024
     tracing: Any = None
+    profile: Any = None
 
 
 @dataclass

@@ -205,6 +205,12 @@ def _measure(seq: int, iters: int, oom_level: int, on_chip: bool, fp8: bool = Fa
                 log_every=0,
                 output_dir=tempfile.mkdtemp(prefix="bench_telemetry_"),
                 tracing=bool(os.environ.get("BENCH_TRACE_OUT")),
+                # Device-time attribution rides along (lagged one step, zero
+                # extra device syncs). capture_cost stays off: the AOT
+                # cost_analysis compile would inflate warmup_s, the bench's
+                # cold-start headline, and without an auto-plan there is no
+                # bandwidth pricing to feed anyway.
+                profile={"capture_cost": False},
             )
         ],
     )
@@ -353,6 +359,19 @@ def child(oom_level: int, budget_s: float = 1e9) -> int:
                           "step_time_ratio", "predicted_hbm_gib",
                           "measured_peak_hbm_gib", "hbm_ratio", "calibrated",
                           "mfu_effective")
+            }
+        # Device-time attribution block (profiler.py via telemetry summary):
+        # term means (compute / exposed comm / data wait / skew / dispatch),
+        # comm-compute overlap ratio, and per-axis achieved-bandwidth
+        # residuals — rows carry it so WHERE the step time went travels with
+        # HOW MUCH it was across rounds.
+        if t.get("profile"):
+            pr = t["profile"]
+            result["telemetry"]["profile"] = {
+                k: pr.get(k)
+                for k in ("steps", "cost_captured", "overlap_ratio_mean",
+                          "terms_mean_s", "tick_terms_mean_s",
+                          "bandwidth_residuals")
             }
         # Training-chaos block (fault_tolerance.py via flush_telemetry):
         # injected-fault and step-watchdog counters ride along so a

@@ -128,6 +128,21 @@ def _tracing_block(tr):
     return block
 
 
+def _profile_block(prof):
+    """The bench-row ``profile`` block (profiler.py DeviceTimeProfiler):
+    per-tick device-time attribution means — where each engine tick's wall
+    went (admit / prefill / decode / host fetch / bookkeeping residual).
+    ``overlap_ratio_mean`` and ``bandwidth_residuals`` only fill in when a
+    training plan priced the profiler; serving-only rows carry them empty
+    rather than invented."""
+    s = prof.summary()
+    block = {k: s.get(k) for k in ("ticks", "overlap_ratio_mean",
+                                   "bandwidth_residuals")}
+    terms = s.get("tick_terms_mean_s") or {}
+    block["tick_terms_mean_s"] = {k: round(v, 6) for k, v in terms.items()}
+    return block
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--params-b", type=float, default=1.0)
@@ -379,7 +394,14 @@ def main():
         scfg = ServingConfig(n_slots=slots, max_len=t_cap,
                              max_prefill_chunk=max(16, args.prompt_len))
         tr_serve = _recorder()
-        engine = ServingEngine(res_model, scfg, tracing=tr_serve)
+        # Standalone device-time profiler: lagged per-tick attribution
+        # (host perf_counter sections, zero extra device syncs) rides the
+        # row so WHERE each tick's wall went travels with the latencies.
+        from accelerate_tpu.profiler import DeviceTimeProfiler
+
+        prof_serve = DeviceTimeProfiler()
+        engine = ServingEngine(res_model, scfg, tracing=tr_serve,
+                               profiler=prof_serve)
         engine.warmup()
         _, serve_s = replay_trace(engine, reqs, arrivals=list(arrivals),
                                   max_new_tokens=[int(b) for b in budgets])
@@ -396,6 +418,8 @@ def main():
             "prefill_executables": st["prefill_executables"],
             "steady_recompiles": st["steady_recompiles"],
         }
+        prof_serve.flush()  # finalize the lagged last tick
+        row["profile"] = _profile_block(prof_serve)
         if tr_serve is not None:
             row["tracing"] = _tracing_block(tr_serve)
             export_tr = tr_serve
@@ -528,9 +552,10 @@ def main():
             from accelerate_tpu import DisaggConfig, DisaggServingEngine
 
             tr_dis = _recorder()
+            prof_dis = DeviceTimeProfiler()
             dengine = DisaggServingEngine(
                 res_model, scfg, disagg=DisaggConfig(n_prefill_lanes=args.lanes),
-                tracing=tr_dis,
+                tracing=tr_dis, profiler=prof_dis,
             )
             dengine.warmup()
             _, dis_s = replay_trace(dengine, reqs, arrivals=list(arrivals),
@@ -547,6 +572,8 @@ def main():
                 "steady_recompiles": dst["steady_recompiles"],
                 "disagg": dst["disagg"],
             }
+            prof_dis.flush()  # finalize the lagged last tick
+            row["profile"] = _profile_block(prof_dis)
             if tr_dis is not None:
                 row["tracing"] = _tracing_block(tr_dis)
                 export_tr = tr_dis

@@ -119,8 +119,41 @@ SUMMARY_ALWAYS = {
 SUMMARY_OPTIONAL = {
     "faults", "watchdog", "serving", "reshard", "disagg", "publish",
     "autoscale", "plan", "tracing", "executables", "compile", "sdc",
+    "profile",
     "step_time_mean_s", "step_time_p50_s", "step_time_p90_s",
     "data_wait_mean_s", "ema_samples_per_s", "ema_tokens_per_s",
+}
+
+# The summary()["profile"] block (profiler.DeviceTimeProfiler.summary).
+PROFILE_SUMMARY_KEYS = {
+    "steps", "ticks", "cost_captured", "overlap_ratio_mean",
+    "terms_mean_s", "tick_terms_mean_s", "bandwidth_residuals", "ring",
+    "flight_dumps",
+}
+
+# Prometheus series a fresh profiled+traced telemetry recorder renders from
+# the ONE MetricsHub renderer — the pinned accelerate_tpu_<subsystem>_<name>
+# scheme plus the one-release legacy aliases. Activity (spans, steps, SLO
+# windows) only ADDS names; this is the floor that must never drift.
+HUB_BASE_METRIC_NAMES = {
+    "accelerate_tpu_telemetry_steps",
+    "accelerate_tpu_telemetry_recompiles",
+    "accelerate_tpu_telemetry_peak_hbm_bytes",
+    "accelerate_tpu_telemetry_checkpoint_events",
+    "accelerate_tpu_profile_steps",
+    "accelerate_tpu_profile_ticks",
+    "accelerate_tpu_profile_cost_captured",
+    "accelerate_tpu_profile_ring_capacity",
+    "accelerate_tpu_profile_ring_len",
+    "accelerate_tpu_profile_flight_dumps",
+    "accelerate_tpu_tracing_spans",
+    "accelerate_tpu_tracing_dropped_spans",
+    "accelerate_tpu_tracing_requests",
+    "accelerate_tpu_tracing_open_spans",
+    "accelerate_tpu_tracing_flows",
+    # deprecated aliases, kept one release (profiler.MetricsHub.alias)
+    "accelerate_tpu_trace_dropped_spans_total",
+    "accelerate_tpu_trace_requests",
 }
 
 
@@ -203,6 +236,43 @@ def test_summary_block_schema(tmp_path):
         f"unpinned summary blocks: {keys - SUMMARY_ALWAYS - SUMMARY_OPTIONAL}")
     assert isinstance(acc.telemetry.tracing, TraceRecorder)
     assert set(out["tracing"]) == TRACING_STATS_KEYS
+
+
+def test_profile_block_schema_and_hub_metric_names(tmp_path):
+    """TelemetryKwargs(profile=True): summary() grows the pinned profile
+    block and the MetricsHub renders the pinned base name set (telemetry +
+    profile + tracing providers plus the one-release legacy aliases)."""
+    from accelerate_tpu import Accelerator, DeviceTimeProfiler
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        kwargs_handlers=[TelemetryKwargs(tracing=True, profile=True,
+                                         log_every=0)],
+    )
+    assert isinstance(acc.telemetry.profiler, DeviceTimeProfiler)
+    out = acc.telemetry.summary()
+    assert set(out["profile"]) == PROFILE_SUMMARY_KEYS
+    names = acc.telemetry.hub.metric_names()
+    assert HUB_BASE_METRIC_NAMES <= names, (
+        f"missing pinned series: {HUB_BASE_METRIC_NAMES - names}")
+    for name in names:
+        assert name.startswith("accelerate_tpu_"), (
+            f"series {name} violates the pinned naming scheme")
+    # One renderer: the legacy exporter surface is a pure delegation.
+    assert acc.telemetry.tracing.metrics_text() == acc.telemetry.hub.render()
+
+
+def test_profile_off_by_default(tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        kwargs_handlers=[TelemetryKwargs(log_every=0)],
+    )
+    assert acc.telemetry.profiler is None
+    assert "profile" not in acc.telemetry.summary()
 
 
 def test_sdc_block_schemas(tmp_path):
