@@ -9,7 +9,7 @@ PYTEST = $(ENV) python -m pytest -q
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
         reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
         autoscale-smoke trace-smoke gameday-smoke sdc-smoke profile-smoke \
-        smoke-all
+        fleet-smoke smoke-all
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -233,11 +233,25 @@ sdc-smoke:
 profile-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.profile_smoke
 
+# Whole-cell-loss game day: a FleetRouter over two journaled cells drains a
+# seeded Poisson trace; chaos partitions cell 0 (terminals pile up
+# journaled but unreported) then hard-kills it mid-trace. The router adopts
+# the dead cell's journal and drains it onto the survivor — cached
+# terminals re-emit without re-executing, in-flight requests resubmit by
+# client_request_id — with every request ok exactly once, rows bit-equal
+# to an uninterrupted reference, the survivor executing exactly N minus
+# what the dead cell already ran, 1 decode executable / 0 steady
+# recompiles per survivor, and scale_up + a cell-granular publish canary
+# promoting fleet-wide afterwards. A second seeded round replays
+# bit-identically. See docs/usage_guides/serving.md "Fleet serving".
+fleet-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.fleet_smoke
+
 # Every acceptance gate back to back with a one-line pass/fail table and a
 # nonzero exit if any gate failed. Serial on purpose: the gates share the
 # CPU cores and several launch their own subprocess gangs.
 SMOKES = telemetry warmup serving plan reshard disagg chaos chaos-train \
-         publish autoscale trace faulttol gameday sdc profile
+         publish autoscale trace faulttol gameday sdc profile fleet
 smoke-all:
 	@fail=0; \
 	for s in $(SMOKES); do \

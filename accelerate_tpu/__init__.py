@@ -84,7 +84,8 @@ from .generation import (  # noqa: E402
 )
 from .serving import ServingEngine, ServingStalledError, replay_trace  # noqa: E402
 from .disagg import DisaggServingEngine  # noqa: E402
-from .journal import RequestJournal  # noqa: E402
+from .journal import JournalAdoptionError, RequestJournal  # noqa: E402
+from .fleet import FleetConfig, FleetDegradedError, FleetRouter  # noqa: E402
 from .publish import PublishConfig, WeightPublisher  # noqa: E402
 from .autoscale import (  # noqa: E402
     AutoscaleConfig,

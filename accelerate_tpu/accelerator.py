@@ -1774,6 +1774,32 @@ class Accelerator:
             tracing=tracing, journal=journal,
         )
 
+    def build_fleet_router(self, cells, config=None, *, chaos=None,
+                           tracing=None):
+        """Construct a :class:`~accelerate_tpu.fleet.FleetRouter` over a
+        registry of journaled serving cells (``{name: engine}`` or a list —
+        each built via :meth:`build_serving_engine` with its OWN
+        ``ServingConfig.journal_dir``), wired to this Accelerator's
+        telemetry. The router adds the cell-granular robustness layer:
+        session-affinity routing with load spillover, per-tick health
+        classification, exactly-once cross-cell drain of a dead cell's
+        journal, and whole-cell canary publish / scale (see
+        :mod:`accelerate_tpu.fleet`). The fleet layer is OFF unless this
+        router is built and ticked.
+
+        ``config`` is a :class:`~accelerate_tpu.fleet.FleetConfig`;
+        ``chaos`` takes a :class:`~accelerate_tpu.chaos.FaultInjector`
+        (``cell_crash`` / ``cell_partition`` / ``router_heartbeat``
+        points); ``tracing`` a
+        :class:`~accelerate_tpu.tracing.TraceRecorder` for fleet spans and
+        the ``accelerate_tpu_fleet_*`` gauge provider."""
+        from .fleet import FleetRouter
+
+        return FleetRouter(
+            cells, config, chaos=chaos, telemetry=self.telemetry,
+            tracing=tracing,
+        )
+
     def build_weight_publisher(self, engine, config=None, *, chaos=None):
         """Construct a :class:`~accelerate_tpu.publish.WeightPublisher` that
         watches this (or another) run's checkpoint directory and hot-swaps

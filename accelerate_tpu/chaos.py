@@ -182,6 +182,10 @@ INJECTION_POINTS = (
     "journal_append",
     "journal_compact",
     "engine_crash",
+    # fleet routing (fleet.py): whole-cell death, partition, heartbeat loss
+    "cell_crash",
+    "cell_partition",
+    "router_heartbeat",
 )
 
 FAULT_KINDS = (
@@ -240,6 +244,16 @@ _POINT_KINDS = {
     "journal_append": ("torn_write",),
     "journal_compact": ("torn_write",),
     "engine_crash": ("crash",),
+    # Fleet routing (fleet.py): a cell_crash hard-kills an entire cell
+    # mid-trace (its engine is abandoned, journal unsealed — the router's
+    # exactly-once cross-cell drain path), a cell_partition makes a cell
+    # unreachable from the router for ``Fault.extra["delay_ticks"]`` ticks
+    # (degraded: it keeps ticking, takes no new admissions, its finished
+    # rows surface when the partition heals), and a router_heartbeat delay
+    # skips one health-reclassification pass (stale states for a tick).
+    "cell_crash": ("crash",),
+    "cell_partition": ("delay",),
+    "router_heartbeat": ("delay",),
 }
 
 _MASK = (1 << 64) - 1

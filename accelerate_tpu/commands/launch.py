@@ -277,7 +277,12 @@ class GangSupervisor:
             self._dead_streak = 0
         n = self.restarts_used
         self.restarts_used += 1
-        delay = (0.0 if cls in ("preempted", "serving-crash", "sdc")
+        # Zero backoff where waiting buys nothing: a preemption auto-saved,
+        # a serving/cell crash left a journal the relaunch replays, and SDC
+        # already quarantined the bad host. "fleet-degraded" deliberately
+        # backs off — every cell is breaching, so a hot relaunch just sheds.
+        delay = (0.0 if cls in ("preempted", "serving-crash", "sdc",
+                                "cell-dead")
                  else _backoff_s(n, self.backoff_s, self.backoff_cap_s))
         return SupervisorDecision("restart", cls, delay_s=delay, num_processes=new_procs)
 

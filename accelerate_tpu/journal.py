@@ -89,7 +89,7 @@ def _log_ok() -> bool:
 
     return bool(PartialState._shared_state)
 
-__all__ = ["RequestJournal", "JOURNAL_FSYNC_POLICIES"]
+__all__ = ["RequestJournal", "JournalAdoptionError", "JOURNAL_FSYNC_POLICIES"]
 
 #: Legal ``fsync`` policies, strongest first.
 JOURNAL_FSYNC_POLICIES = ("every_record", "every_tick", "os")
@@ -98,6 +98,14 @@ _PREFIX = "wal_"
 _SEALED = ".jsonl"
 _OPEN = ".jsonl.open"
 _COMPACT_STAGING = "compact.jsonl.tmp"
+_ADOPTION = "adopted.lock"
+
+
+class JournalAdoptionError(RuntimeError):
+    """A second party tried to adopt a journal directory that is already
+    claimed. Raised so a recovering fleet router and a restarting gang
+    supervisor can never BOTH replay the same dead cell's WAL — double
+    adoption is double execution."""
 
 
 def _fsync_helpers():
@@ -167,11 +175,97 @@ class RequestJournal:
         # replay() when this object is opened over an existing directory.
         self._retired: set[int] = set()
         self._admitted: set[int] = set()
+        self._adoption_owner: Optional[str] = None
         self._c = {
             "appends": 0, "bytes_written": 0, "syncs": 0, "rotations": 0,
             "compactions": 0, "compact_aborts": 0, "records_retired": 0,
             "torn_writes": 0, "torn_tails": 0, "corrupt_skipped": 0,
         }
+
+    # -- cross-process adoption -------------------------------------------
+
+    @classmethod
+    def adopt(cls, journal_dir: str, owner: str, *, force: bool = False,
+              fsync: str = "every_tick", segment_records: int = 512,
+              chaos=None) -> "RequestJournal":
+        """Open another (dead) engine's journal directory for replay, first
+        claiming the adoption sentinel so exactly one party drains it.
+        Raises :class:`JournalAdoptionError` if someone else already holds
+        the claim (``force=True`` evicts a stale sentinel — only safe when
+        the previous adopter is known dead)."""
+        jr = cls(journal_dir, fsync=fsync,
+                 segment_records=segment_records, chaos=chaos)
+        jr.acquire_adoption(owner, force=force)
+        return jr
+
+    @property
+    def adopted(self) -> bool:
+        return self._adoption_owner is not None
+
+    def acquire_adoption(self, owner: str, *, force: bool = False) -> None:
+        """Atomically claim this directory's adoption sentinel
+        (``O_CREAT | O_EXCL`` — the filesystem arbitrates the race). The
+        sentinel names the adopter and pid; it is invisible to segment
+        scans (no ``wal_`` prefix) and removed by :meth:`release_adoption`
+        or a clean :meth:`close`."""
+        path = os.path.join(self.dir, _ADOPTION)
+        payload = json.dumps({"owner": str(owner), "pid": os.getpid()},
+                             separators=(",", ":")) + "\n"
+        for attempt in (0, 1):
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o644)
+                break
+            except FileExistsError:
+                holder = None
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        holder = json.loads(f.read() or "{}")
+                except (OSError, ValueError):
+                    holder = None
+                if not force or attempt:
+                    who = (holder or {}).get("owner", "<unreadable>")
+                    raise JournalAdoptionError(
+                        f"journal {self.dir!r} is already adopted by "
+                        f"{who!r} — refusing double adoption (pass "
+                        f"force=True only if that adopter is known dead)"
+                    )
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        os.write(fd, payload.encode("utf-8"))
+        os.fsync(fd)
+        os.close(fd)
+        _fsync_file, _fsync_dir = _fsync_helpers()
+        _fsync_dir(self.dir)
+        self._adoption_owner = str(owner)
+
+    def adoption_holder(self) -> Optional[dict]:
+        """The adoption sentinel's payload (owner, pid) if the directory is
+        claimed, else None. Lets a restarting engine notice that a fleet
+        router already drained this WAL before it replays anything."""
+        try:
+            with open(os.path.join(self.dir, _ADOPTION),
+                      "r", encoding="utf-8") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        try:
+            holder = json.loads(raw or "{}")
+        except ValueError:
+            return {}
+        return holder if isinstance(holder, dict) else {}
+
+    def release_adoption(self) -> None:
+        """Drop the adoption claim (no-op if this journal never held it)."""
+        if self._adoption_owner is None:
+            return
+        try:
+            os.remove(os.path.join(self.dir, _ADOPTION))
+        except OSError:
+            pass
+        self._adoption_owner = None
 
     # -- segment bookkeeping ----------------------------------------------
 
@@ -403,9 +497,11 @@ class RequestJournal:
 
     def close(self) -> None:
         """Clean shutdown: seal the active segment (full fsync + atomic
-        rename) regardless of the append-path fsync policy."""
+        rename) regardless of the append-path fsync policy, and release
+        any adoption claim this journal holds."""
         if self._fh is not None:
             self._seal()
+        self.release_adoption()
 
     def stats(self) -> dict:
         """The journal telemetry block (embedded under

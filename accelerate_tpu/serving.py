@@ -1436,8 +1436,16 @@ class ServingEngine:
         if self._journal is None and journal_dir is not None:
             from .journal import RequestJournal
 
-            self._journal = RequestJournal(
-                str(journal_dir), fsync=self.config.journal_fsync,
+            # An explicit foreign directory — some dead engine's WAL this
+            # engine is taking over. Claim the adoption sentinel first so a
+            # fleet router draining the same cell can't also replay it
+            # (double adoption is double execution); raises
+            # JournalAdoptionError if someone else already holds it. The
+            # claim transfers ownership: this engine keeps journaling here
+            # and releases the sentinel on close().
+            self._journal = RequestJournal.adopt(
+                str(journal_dir), f"serving-recover:pid={os.getpid()}",
+                fsync=self.config.journal_fsync,
                 segment_records=self.config.journal_segment_records,
             )
             self._journal.chaos = self._chaos
@@ -1450,6 +1458,21 @@ class ServingEngine:
                 "ServingConfig.journal_dir, or construct the engine with "
                 "journal=."
             )
+        if not self._journal.adopted:
+            # The restarting-supervisor side of the same race: if a fleet
+            # router claimed this directory (it is draining — or already
+            # drained — these requests onto surviving cells), replaying
+            # them here too would double-execute.
+            holder = self._journal.adoption_holder()
+            if holder is not None:
+                from .journal import JournalAdoptionError
+
+                raise JournalAdoptionError(
+                    f"journal {self._journal.dir!r} is adopted by "
+                    f"{holder.get('owner', '<unreadable>')!r} — its requests "
+                    "were drained elsewhere; relaunch with a fresh "
+                    "journal_dir instead of replaying this one"
+                )
         t_start = time.perf_counter()
         tr = self.tracing
         span = (tr.begin("serving", "recover", self._stats["ticks"])
@@ -1479,10 +1502,13 @@ class ServingEngine:
                 recovers[rid] = recovers.get(rid, 0) + 1
         now = time.perf_counter()
         n_terminal = n_inflight = 0
-        for rid in sorted(admits):
-            a = admits[rid]
-            cid = a.get("cid")
+        # Union, not just admits: compaction retires the admit of a finished
+        # request (the terminal row is self-contained), so after a compact +
+        # crash a cached reply may exist with no admit left on disk.
+        for rid in sorted(set(admits) | set(terminals)):
+            a = admits.get(rid)
             trec = terminals.get(rid)
+            cid = a.get("cid") if a is not None else trec.get("cid")
             if trec is not None:
                 result = {
                     "id": rid, "status": trec.get("status"),
@@ -1542,9 +1568,9 @@ class ServingEngine:
                 tr.request_retry(rid, self._stats["ticks"],
                                  reason="recovered",
                                  attempt=req.retries + req.recoveries)
-        if admits:
+        if admits or terminals:
             # Fresh ids must never collide with journaled ones.
-            self._ids = itertools.count(max(admits) + 1)
+            self._ids = itertools.count(max([*admits, *terminals]) + 1)
         self._journal.tick_flush()
         self._jstats["recovered_inflight"] += n_inflight
         self._jstats["recovered_terminal"] += n_terminal

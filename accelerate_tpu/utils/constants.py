@@ -95,6 +95,17 @@ SERVING_CRASH_EXIT_CODE = 78
 # backoff, excluding it; elastic resume reshards the newest verified
 # checkpoint onto the smaller gang.
 SDC_EXIT_CODE = 79
+# A whole serving CELL died under a fleet router (fleet.py): its engine
+# stopped making progress (max_idle_ticks) or its process exited. The
+# router already drained the cell's journal onto survivors exactly-once,
+# so a cell supervisor relaunches the cell with ZERO backoff — the WAL
+# adoption sentinel (journal.py) keeps the relaunch from re-draining what
+# the router already took.
+CELL_DEAD_EXIT_CODE = 80
+# The fleet itself is degraded: every cell is breaching its queue-depth
+# band (router-level shed) or no healthy cell remains to drain onto. More
+# capacity, not a faster restart, is the fix — relaunch with backoff.
+FLEET_DEGRADED_EXIT_CODE = 81
 
 EXIT_CODE_TABLE = (
     # (code, constant, classification, supervisor response)
@@ -118,6 +129,14 @@ EXIT_CODE_TABLE = (
      "classification": "sdc",
      "response": "relaunch SHRUNK with zero backoff, quarantined host "
                  "excluded (persisted in the quarantine file)"},
+    {"code": CELL_DEAD_EXIT_CODE, "constant": "CELL_DEAD_EXIT_CODE",
+     "classification": "cell-dead",
+     "response": "relaunch the cell with zero backoff; the fleet router "
+                 "already drained its journal onto survivors"},
+    {"code": FLEET_DEGRADED_EXIT_CODE, "constant": "FLEET_DEGRADED_EXIT_CODE",
+     "classification": "fleet-degraded",
+     "response": "relaunch with backoff — every cell is breaching, more "
+                 "capacity is the fix, not a faster restart"},
     {"code": 130, "constant": None, "classification": "interrupted",
      "response": "stop — the operator hit Ctrl-C"},
     {"code": 137, "constant": None, "classification": "oom",
@@ -136,7 +155,8 @@ PROTOCOL_EXIT_CLASSES = {
     for row in EXIT_CODE_TABLE
     if row["code"] in (PREEMPTION_EXIT_CODE, TRAINING_STALLED_EXIT_CODE,
                        POISONED_CHECKPOINT_EXIT_CODE, SERVING_CRASH_EXIT_CODE,
-                       SDC_EXIT_CODE)
+                       SDC_EXIT_CODE, CELL_DEAD_EXIT_CODE,
+                       FLEET_DEGRADED_EXIT_CODE)
 }
 
 # On-disk quarantine record (sdc.py): written next to the checkpoints when a

@@ -179,6 +179,12 @@ def main():
                          "row — target < 1%% tokens/s; implies --serving)")
     ap.add_argument("--sdc-every", type=int, default=8,
                     help="canary probe cadence in engine ticks for --sdc")
+    ap.add_argument("--fleet", action="store_true",
+                    help="add a serving_fleet row (the request set through a "
+                         "two-cell FleetRouter with a seeded cell_crash "
+                         "killing cell 0 mid-trace: per-cell tokens/s, "
+                         "spillover rate, measured drain time, executable "
+                         "census per cell; implies --serving)")
     ap.add_argument("--autoscale", action="store_true",
                     help="add a serving_autoscale row (diurnal trace through "
                          "a half-mesh disagg engine with an "
@@ -206,7 +212,7 @@ def main():
     if args.trace_out:
         args.tracing = True
     if args.disagg or args.chaos or args.publish or args.autoscale \
-            or args.journal or args.sdc:
+            or args.journal or args.sdc or args.fleet:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -539,6 +545,70 @@ def main():
                 "steady_recompiles": dst_sdc["steady_recompiles"],
                 "sdc": dst_sdc["sdc"],
             }), flush=True)
+
+        # Fleet row: the same request set through a two-cell FleetRouter
+        # (one journaled engine per cell) with a seeded cell_crash killing
+        # cell 0 mid-trace — prices whole-cell failover: the router adopts
+        # the dead cell's journal and drains it onto the survivor. Per-cell
+        # tokens/s, spillover rate, the measured drain time, and the
+        # executable census per surviving cell ride the row.
+        if args.fleet:
+            from accelerate_tpu import FaultInjector, FleetRouter
+
+            froot = tempfile.mkdtemp(prefix="gen_bench_fleet_")
+            fcells = {}
+            for i in range(2):
+                feng = ServingEngine(res_model, ServingConfig(
+                    n_slots=slots, max_len=t_cap,
+                    max_prefill_chunk=max(16, args.prompt_len),
+                    journal_dir=os.path.join(froot, f"wal{i}")))
+                feng.warmup()
+                fcells[f"c{i}"] = feng
+            crash_tick = max(2, n // 3)
+            fchaos = FaultInjector(seed=args.chaos_seed, schedule=[
+                {"point": "cell_crash", "kind": "crash",
+                 "tick": crash_tick, "unit": 0}])
+            frouter = FleetRouter(fcells, chaos=fchaos)
+            fok = 0
+            t0 = time.perf_counter()
+            for i in range(n):  # tick-aligned arrivals: one per router tick
+                frouter.submit(reqs[i], max_new_tokens=int(budgets[i]),
+                               client_request_id=f"fleet-bench-{i}",
+                               session_id=f"sess-{i}")
+                frouter.tick()
+                fok += sum(1 for r in frouter.poll()
+                           if r["status"] == "ok")
+            while frouter.pending:
+                frouter.tick()
+                fok += sum(1 for r in frouter.poll()
+                           if r["status"] == "ok")
+            fleet_s = time.perf_counter() - t0
+            fs = frouter.stats()
+            fper = {}
+            for name, block in fs["per_cell"].items():
+                cell = frouter._cells[name]
+                fper[name] = {
+                    "state": block["state"],
+                    "tokens_per_s": (cell.engine.stats()["tokens_per_s"]
+                                     if not cell.dead else None),
+                    "requests_completed": block["requests_completed"],
+                    "decode_executables": block["decode_executables"],
+                    "steady_recompiles": block["steady_recompiles"],
+                }
+            print(json.dumps({
+                "row": "serving_fleet", "seconds": round(fleet_s, 3),
+                "cells": fs["cells"], "dead": fs["dead"],
+                "crash_tick": crash_tick, "requests": n, "ok": fok,
+                "spillover_rate": (round(
+                    fs["routed_spilled"] / fs["submitted"], 4)
+                    if fs["submitted"] else None),
+                "shed": fs["shed"],
+                "drain_s": fs["drain_last_s"],
+                "drained_cached": fs["drained_cached"],
+                "drained_resubmitted": fs["drained_resubmitted"],
+                "per_cell": fper,
+            }), flush=True)
+            frouter.close()
 
         # Disaggregated row: the same trace through the two-mesh router —
         # planner-sized prefill/decode slices, streamed KV-page handoff. The

@@ -136,6 +136,8 @@ def test_injector_validation():
         "autoscale_decide", "resize_transfer", "load_spike",
         # crash durability
         "journal_append", "journal_compact", "engine_crash",
+        # fleet routing
+        "cell_crash", "cell_partition", "router_heartbeat",
     }
 
 

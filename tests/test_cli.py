@@ -299,6 +299,8 @@ def test_classify_exit_table():
     assert classify_exit(-_signal.SIGSEGV) == "dead-host"
     assert classify_exit(134) == "dead-host"  # 128 + SIGABRT
     assert classify_exit(79) == "sdc"  # SDC_EXIT_CODE
+    assert classify_exit(80) == "cell-dead"  # CELL_DEAD_EXIT_CODE
+    assert classify_exit(81) == "fleet-degraded"  # FLEET_DEGRADED_EXIT_CODE
     assert classify_exit(1) == "fatal"
     assert classify_exit(17) == "fatal"
 
@@ -321,7 +323,8 @@ def test_exit_code_table_is_single_source_of_truth():
     # The resumable protocol subset the classifier resolves table-first.
     assert constants.PROTOCOL_EXIT_CLASSES == {
         75: "preempted", 76: "stalled", 77: "poisoned",
-        78: "serving-crash", 79: "sdc"}
+        78: "serving-crash", 79: "sdc", 80: "cell-dead",
+        81: "fleet-degraded"}
 
 
 def test_supervisor_sdc_shrinks_with_zero_backoff():
@@ -343,6 +346,24 @@ def test_supervisor_sdc_shrinks_with_zero_backoff():
     d2 = sup2.decide(SDC_EXIT_CODE, uptime_s=5.0, num_processes=4)
     assert d2.num_processes == 2 and d2.delay_s == 0.0
     assert sup2._dead_streak == 0
+
+
+def test_supervisor_fleet_exit_codes():
+    """The fleet classes (PR 18): a dead CELL relaunches with zero backoff
+    (the router already drained its journal onto survivors, so the restart
+    is immediately productive with a fresh WAL dir); a degraded FLEET backs
+    off — every cell is breaching, so a hot relaunch would just shed."""
+    from accelerate_tpu.commands.launch import GangSupervisor
+    from accelerate_tpu.utils.constants import (
+        CELL_DEAD_EXIT_CODE, FLEET_DEGRADED_EXIT_CODE)
+
+    sup = GangSupervisor(max_restarts=3, backoff_s=0.5)
+    d = sup.decide(CELL_DEAD_EXIT_CODE, uptime_s=100.0, num_processes=4)
+    assert d.action == "restart" and d.classification == "cell-dead"
+    assert d.delay_s == 0.0
+    d = sup.decide(FLEET_DEGRADED_EXIT_CODE, uptime_s=100.0, num_processes=4)
+    assert d.action == "restart" and d.classification == "fleet-degraded"
+    assert d.delay_s > 0
 
 
 def test_restart_backoff_deterministic_and_capped():

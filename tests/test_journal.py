@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from accelerate_tpu import (
     FaultInjector,
+    JournalAdoptionError,
     Model,
     RequestJournal,
     ServingConfig,
@@ -238,6 +239,176 @@ def test_chaos_torn_compact_aborts_cleanly(tmp_path):
     j2 = RequestJournal(str(tmp_path))
     j2.replay()
     assert j2.compact() > 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-process adoption (PR 18): exactly one party drains a dead WAL
+# ---------------------------------------------------------------------------
+
+
+def test_adoption_sentinel_refuses_double_adoption(tmp_path):
+    """The double-adoption refusal regression: a recovering fleet router
+    and a restarting supervisor racing for the same dead engine's journal
+    must resolve to exactly ONE adopter — double adoption is double
+    execution."""
+    d = str(tmp_path)
+    j1 = RequestJournal.adopt(d, "fleet-router:tick=3:cell=cell0")
+    assert j1.adopted
+    with pytest.raises(JournalAdoptionError, match="already adopted"):
+        RequestJournal.adopt(d, "supervisor:pid=999")
+    # The sentinel names the holder for the loser's error path.
+    assert RequestJournal(d).adoption_holder()["owner"].startswith(
+        "fleet-router")
+    # The sentinel is invisible to segment scans and replay.
+    j1.append({"t": "admit", "rid": 0})
+    j1.close()  # close releases the claim
+    assert RequestJournal(d).adoption_holder() is None
+    out, scan = RequestJournal(d).replay()
+    assert [r["rid"] for r in out] == [0] and scan["segments"] == 1
+    # Released: the next adopter wins; force= evicts a stale claim.
+    j2 = RequestJournal.adopt(d, "supervisor:pid=999")
+    j3 = RequestJournal.adopt(d, "forced", force=True)
+    assert j3.adopted
+    j2.release_adoption()  # holder already evicted: a no-op either way
+    j3.release_adoption()
+
+
+def test_recover_over_foreign_dir_takes_the_adoption_lock(llama, tmp_path):
+    """``recover(journal_dir=)`` on a dir some DEAD engine owned claims the
+    sentinel: a second engine trying the same dir refuses, and a restart
+    over its own configured dir refuses while a router holds the claim."""
+    cfg, model = llama
+    wal = str(tmp_path / "wal")
+    mk = lambda **kw: ServingConfig(  # noqa: E731
+        n_slots=2, max_len=32, prefill_chunks=[4, 8], **kw)
+    (p,) = _prompts(cfg, [5])
+    e1 = ServingEngine(model, mk(journal_dir=wal))
+    rid = e1.submit(p, max_new_tokens=3, client_request_id="req-0")
+    e1.journal.tick_flush()
+    del e1  # dead: unsealed .open segment, no sentinel
+
+    # The router-style takeover: a journal-less engine adopts the dir.
+    e2 = ServingEngine(model, mk())
+    assert e2.recover(journal_dir=wal)["recovered_inflight"] == 1
+    assert e2.journal.adopted
+    # A second adopter — engine or raw journal — refuses while it's held.
+    e3 = ServingEngine(model, mk())
+    with pytest.raises(JournalAdoptionError, match="already adopted"):
+        e3.recover(journal_dir=wal)
+    # A restarting supervisor's engine over its OWN configured dir also
+    # refuses: these requests are being drained elsewhere.
+    e4 = ServingEngine(model, mk(journal_dir=wal))
+    with pytest.raises(JournalAdoptionError, match="drained elsewhere"):
+        e4.recover()
+    # The adopter drains the replay bit-for-bit as usual...
+    rows = _drain(e2)
+    assert rows[rid]["status"] == "ok" and rows[rid]["recovered"] is True
+    # ...and close() releases the claim for the next owner.
+    e2.close()
+    assert RequestJournal(wal).adoption_holder() is None
+
+
+# ---------------------------------------------------------------------------
+# Compaction racing a crash (PR 18): only the happy path was pinned before
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_commit_crash_duplicates_replay_exactly_once(
+        llama, tmp_path, monkeypatch):
+    """A crash BETWEEN compaction's two commit steps (the merged segment
+    has replaced sealed[0], the stale sealed[1:] not yet unlinked) leaves
+    duplicate records on disk — journal.py documents them as idempotently
+    re-read. Pin that: recovery over the duplicated WAL is still
+    exactly-once, bit-equal."""
+    cfg, model = llama
+    wal = str(tmp_path / "wal")
+    mk = lambda: ServingConfig(  # noqa: E731
+        n_slots=2, max_len=32, prefill_chunks=[4, 8],
+        journal_dir=wal, journal_segment_records=4)
+    prompts = _prompts(cfg, [5, 7, 6, 8])
+
+    real_remove = os.remove
+
+    def crashy_remove(path):
+        # The unlink step of compaction "crashes": stale sealed segments
+        # stay on disk. compact() treats the OSError as best-effort.
+        if os.path.basename(path).startswith("wal_") and wal in path:
+            raise OSError("injected crash between commit steps")
+        real_remove(path)
+
+    monkeypatch.setattr(os, "remove", crashy_remove)
+    e1 = ServingEngine(model, mk())
+    ref = {}
+    for i, p in enumerate(prompts[:3]):
+        ref[i] = e1.submit(p, max_new_tokens=4, client_request_id=f"req-{i}")
+    done = _drain(e1)
+    assert e1.stats()["journal"]["compactions"] >= 1
+    rid_inflight = e1.submit(prompts[3], max_new_tokens=4,
+                             client_request_id="req-3")
+    e1.journal.tick_flush()
+    del e1  # crash: duplicates + an in-flight admit on disk
+
+    # The duplicates are really there: more admit records than rids.
+    recs, _ = RequestJournal(wal).replay()
+    admit_rids = [r["rid"] for r in recs if r["t"] == "admit"]
+    assert len(admit_rids) > len(set(admit_rids))
+
+    e2 = ServingEngine(model, mk())
+    summary = e2.recover()
+    # Exactly-once despite the duplicated records: each terminal re-emits
+    # ONE cached row, the in-flight request replays ONCE.
+    assert summary["recovered_terminal"] == 3
+    assert summary["recovered_inflight"] == 1
+    rows = {r["id"]: r for r in e2.poll()}
+    assert sorted(rows) == sorted(ref.values())
+    for i in (0, 1, 2):
+        np.testing.assert_array_equal(rows[ref[i]]["tokens"],
+                                      done[ref[i]]["tokens"])
+    rows.update(_drain(e2))
+    assert rows[rid_inflight]["status"] == "ok"
+    assert e2.stats()["requests_completed"] == 1  # only the replay ran
+
+
+def test_segment_sealed_mid_compaction_replays_exactly_once(llama, tmp_path):
+    """The other side of the race: segments keep SEALING while every
+    compaction pass aborts mid-write (chaos torn_write at journal_compact),
+    then the process dies. The accumulated sealed-but-never-compacted
+    history must still recover exactly-once."""
+    cfg, model = llama
+    wal = str(tmp_path / "wal")
+    chaos = FaultInjector(seed=2, rates={"journal_compact": {"torn_write": 1.0}})
+    mk = lambda ch: ServingConfig(  # noqa: E731
+        n_slots=2, max_len=32, prefill_chunks=[4, 8],
+        journal_dir=wal, journal_segment_records=4)
+    e1 = ServingEngine(model, mk(chaos), chaos=chaos)
+    prompts = _prompts(cfg, [5, 7, 6, 8])
+    ref = {}
+    for i, p in enumerate(prompts[:3]):
+        ref[i] = e1.submit(p, max_new_tokens=4, client_request_id=f"req-{i}")
+    done = _drain(e1)
+    js = e1.stats()["journal"]
+    assert js["compact_aborts"] >= 1 and js["compactions"] == 0
+    assert js["rotations"] >= 2  # segments sealed while compaction failed
+    rid_inflight = e1.submit(prompts[3], max_new_tokens=4,
+                             client_request_id="req-3")
+    e1.journal.tick_flush()
+    del e1  # crash mid-flight, un-compacted multi-segment history behind
+
+    e2 = ServingEngine(model, mk(None))
+    summary = e2.recover()
+    assert summary["recovered_terminal"] == 3
+    assert summary["recovered_inflight"] == 1
+    assert summary["segments"] >= 3
+    rows = {r["id"]: r for r in e2.poll()}
+    for i in range(3):
+        np.testing.assert_array_equal(rows[ref[i]]["tokens"],
+                                      done[ref[i]]["tokens"])
+    rows.update(_drain(e2))
+    assert rows[rid_inflight]["status"] == "ok"
+    assert e2.stats()["requests_completed"] == 1
+    # The un-compacted history compacts fine under the new owner.
+    e2.journal.replay()
+    assert e2.journal.compact() > 0
 
 
 # ---------------------------------------------------------------------------

@@ -107,6 +107,25 @@ AUTOSCALE_KEYS = {
     "cooldown_until_tick", "breach_over", "breach_under", "last_action",
 }
 
+# Fleet-router poll rows are the engine row plus routing provenance; the
+# stats() block feeds the MetricsHub ``accelerate_tpu_fleet_*`` series and
+# the serving_fleet bench row.
+FLEET_POLL_ROW_KEYS = POLL_ROW_KEYS | {"cell", "spilled", "drained_from"}
+
+FLEET_STATS_KEYS = {
+    "cells", "healthy", "degraded", "draining", "dead", "ticks",
+    "submitted", "deduped", "routed_affinity", "routed_spilled", "shed",
+    "completed", "ok", "heartbeat_skips",
+    "drains", "drained_cached", "drained_resubmitted", "drain_last_s",
+    "publishes", "promoted", "rolled_back", "quarantined_versions",
+    "scale_ups", "scale_downs", "per_cell",
+}
+
+FLEET_PER_CELL_KEYS = {
+    "state", "pending", "weights_version", "queue_depth_p95",
+    "requests_completed", "decode_executables", "steady_recompiles",
+}
+
 TRACING_STATS_KEYS = {
     "spans", "dropped_spans", "by_kind", "requests", "open_spans", "flows",
 }
@@ -219,6 +238,51 @@ def test_autoscale_stats_schema(llama):
     )
     ctl = AutoscaleController(engine, AutoscaleConfig())
     assert set(ctl.stats()) == AUTOSCALE_KEYS
+
+
+def test_fleet_stats_and_poll_row_schema(llama, tmp_path):
+    """The fleet.py observability surface: stats() block keys, per-cell
+    sub-block keys, poll rows = engine schema + provenance, and the
+    MetricsHub ``accelerate_tpu_fleet_*`` series floor."""
+    from types import SimpleNamespace
+
+    from accelerate_tpu import FleetRouter, MetricsHub
+
+    cfg, model = llama
+    hub = MetricsHub()
+    telemetry = SimpleNamespace(hub=hub, record_event=lambda *a, **k: None)
+    cells = {
+        f"c{i}": ServingEngine(model, ServingConfig(
+            n_slots=2, max_len=32, prefill_chunks=[4, 8],
+            journal_dir=str(tmp_path / f"wal{i}")))
+        for i in range(2)
+    }
+    router = FleetRouter(cells, telemetry=telemetry)
+    for i, p in enumerate(_prompts(cfg, [5, 9])):
+        router.submit(p, max_new_tokens=2, client_request_id=f"r{i}")
+    rows = []
+    while router.pending:
+        router.tick()
+        rows.extend(router.poll())
+    assert len(rows) == 2
+    for row in rows:
+        assert set(row) == FLEET_POLL_ROW_KEYS
+        assert row["status"] == "ok"
+    stats = router.stats()
+    assert set(stats) == FLEET_STATS_KEYS
+    for name, block in stats["per_cell"].items():
+        assert name in cells
+        assert set(block) == FLEET_PER_CELL_KEYS
+    names = hub.metric_names()
+    fleet_names = {n for n in names if n.startswith("accelerate_tpu_fleet_")}
+    assert {
+        "accelerate_tpu_fleet_cells",
+        "accelerate_tpu_fleet_healthy",
+        "accelerate_tpu_fleet_submitted",
+        "accelerate_tpu_fleet_completed",
+        "accelerate_tpu_fleet_drains",
+    } <= fleet_names, f"missing fleet series in {sorted(fleet_names)}"
+    router.close()
 
 
 def test_summary_block_schema(tmp_path):
