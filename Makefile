@@ -9,7 +9,7 @@ PYTEST = $(ENV) python -m pytest -q
         telemetry-smoke warmup-smoke faulttol-smoke serving-smoke plan-smoke \
         reshard-smoke disagg-smoke chaos-smoke chaos-train-smoke publish-smoke \
         autoscale-smoke trace-smoke gameday-smoke sdc-smoke profile-smoke \
-        fleet-smoke smoke-all
+        fleet-smoke spec-smoke smoke-all
 
 # Parallel across available cores (pytest-xdist): launched subprocess tests
 # draw fresh rendezvous ports per gang (utils/other.py get_free_port), so
@@ -247,11 +247,24 @@ profile-smoke:
 fleet-smoke:
 	$(ENV) python -m accelerate_tpu.test_utils.scripts.fleet_smoke
 
+# Speculative-decoding + quantized-KV gate: a seeded 24-request trace runs
+# non-speculative, speculative (n-gram self-draft, k=4 verified in ONE
+# batched forward), int8-KV colocated, and int8-KV disagg with speculation
+# on. Speculative greedy rows must be BIT-EQUAL to the reference (exact
+# rejection sampling), decode must stay ONE executable with 0 steady
+# recompiles with speculation AND int8 KV enabled, int8 disagg rows must be
+# bit-equal to int8 colocated (lossless quantized handoff) with the byte
+# accounting showing >= 40% handoff savings, and int8 output must stay
+# within the documented cross-dtype tolerance of the float reference. See
+# docs/usage_guides/serving.md "Speculative decoding".
+spec-smoke:
+	$(ENV) python -m accelerate_tpu.test_utils.scripts.spec_smoke
+
 # Every acceptance gate back to back with a one-line pass/fail table and a
 # nonzero exit if any gate failed. Serial on purpose: the gates share the
 # CPU cores and several launch their own subprocess gangs.
 SMOKES = telemetry warmup serving plan reshard disagg chaos chaos-train \
-         publish autoscale trace faulttol gameday sdc profile fleet
+         publish autoscale trace faulttol gameday sdc profile fleet spec
 smoke-all:
 	@fail=0; \
 	for s in $(SMOKES); do \

@@ -186,6 +186,9 @@ INJECTION_POINTS = (
     "cell_crash",
     "cell_partition",
     "router_heartbeat",
+    # speculative decoding + quantized KV pages (serving.py / disagg.py)
+    "draft_mismatch",
+    "page_dequant",
 )
 
 FAULT_KINDS = (
@@ -254,6 +257,16 @@ _POINT_KINDS = {
     "cell_crash": ("crash",),
     "cell_partition": ("delay",),
     "router_heartbeat": ("delay",),
+    # Speculative decoding (serving.py): a draft_mismatch poison wipes one
+    # decoding slot's n-gram history (-1 fill), collapsing its acceptance
+    # rate to the floor — output must stay bit-equal, only throughput and
+    # the acceptance telemetry move (the verifiable property).
+    "draft_mismatch": ("poison",),
+    # Quantized KV pages (disagg.py): a page_dequant poison NaNs the
+    # handed-off page's dequant scales, so the decode side's in-kernel
+    # dequantize propagates NaN into attention — the existing poison-slot
+    # quarantine/retry machinery must catch it.
+    "page_dequant": ("poison",),
 }
 
 _MASK = (1 << 64) - 1

@@ -68,7 +68,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, SingleDeviceSharding
 
 from .chaos import InjectedFaultError, deterministic_jitter
-from .generation import KVCache, init_slot_cache
+from .generation import KVCache, QuantPages, init_slot_cache
 from .logging import get_logger
 from .planner import (BandwidthTable, PlannerError, kv_bytes_per_token,
                       plan_disagg_slices)
@@ -242,7 +242,8 @@ class DisaggServingEngine(ServingEngine):
                     init_slot_cache(self.cfg, 1, self.t_max,
                                     dtype=self.config.cache_dtype), dev),
                 state=jax.device_put(
-                    init_slot_state(1, seed=self.config.seed), dev),
+                    init_slot_state(1, seed=self.config.seed,
+                                    history=self._spec_ngram), dev),
             ))
         # FIFO lane reuse: grants take the least-recently-freed lane, so a
         # request wave strides across every lane (and warmup covers each
@@ -260,10 +261,12 @@ class DisaggServingEngine(ServingEngine):
 
         # Page extract: slice the lane's freshly written page out of its
         # (L, 1, T_max, Hkv, D) cache. One executable per ladder rung.
+        # Tree-mapped so int8 QuantPages (data + per-page scale leaves,
+        # both T-major on axis 2) slice as one unit.
         self._extract = jax.jit(
-            lambda k, v, start, size: (
-                jax.lax.dynamic_slice_in_dim(k, start, size, axis=2),
-                jax.lax.dynamic_slice_in_dim(v, start, size, axis=2),
+            lambda k, v, start, size: jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, start, size, axis=2),
+                (k, v),
             ),
             static_argnums=(3,),
         )
@@ -272,10 +275,13 @@ class DisaggServingEngine(ServingEngine):
         # cache at the request's own offset, and commit its true length.
         def _insert(cache: KVCache, k_page, v_page, slot, start, valid):
             zero = jnp.zeros((), jnp.int32)
-            k = jax.lax.dynamic_update_slice(
-                cache.k, k_page, (zero, slot, start, zero, zero))
-            v = jax.lax.dynamic_update_slice(
-                cache.v, v_page, (zero, slot, start, zero, zero))
+
+            def upd(a, page):
+                return jax.lax.dynamic_update_slice(
+                    a, page, (zero, slot, start, zero, zero))
+
+            k = jax.tree.map(upd, cache.k, k_page)
+            v = jax.tree.map(upd, cache.v, v_page)
             return KVCache(k, v, cache.length.at[slot].set(start + valid))
 
         self._insert = jax.jit(_insert, donate_argnums=(0,))
@@ -285,7 +291,7 @@ class DisaggServingEngine(ServingEngine):
         # colocated prefill's final chunk writes (garbage written by
         # intermediate chunks is unobservable there too: active stays
         # False until this moment).
-        def _arm(state: SlotState, slot, tok, done0, budget, carry):
+        def _arm(state: SlotState, slot, tok, done0, budget, carry, hist):
             return SlotState(
                 last_token=state.last_token.at[slot].set(tok),
                 active=state.active.at[slot].set(True),
@@ -293,6 +299,7 @@ class DisaggServingEngine(ServingEngine):
                 generated=state.generated.at[slot].set(1),
                 budget=state.budget.at[slot].set(budget),
                 rng=state.rng.at[slot].set(carry),
+                history=state.history.at[slot].set(hist),
             )
 
         self._arm = jax.jit(_arm, donate_argnums=(0,))
@@ -308,7 +315,7 @@ class DisaggServingEngine(ServingEngine):
             for _ in range(4):
                 # No live rows: lengths pass through unchanged, k/v garbage
                 # lands where inserts overwrite or attention never reaches.
-                self._cache, self._state, _, _ = self._decode(
+                self._cache, self._state, _, _, _ = self._decode(
                     self._params, self._cache, self._state, self._full_mask)
 
         if _log_ok():
@@ -473,7 +480,8 @@ class DisaggServingEngine(ServingEngine):
             # prefill chunk advanced to — decode then continues the SAME
             # per-request stream the colocated engine would.
             arm = jax.device_put(
-                (tok, done0, lane.state.rng[0]), self._decode_sharding)
+                (tok, done0, lane.state.rng[0], lane.state.history[0]),
+                self._decode_sharding)
         self._handoffs.append(_Handoff(
             slot=req.slot, start=start, valid=int(valid), pages=pages_d,
             nbytes=nbytes, arm=arm, budget=int(req.budget), t0=t0,
@@ -565,6 +573,19 @@ class DisaggServingEngine(ServingEngine):
                  jnp.full_like(pages[1], jnp.nan)),
                 self._decode_sharding,
             )
+        if isinstance(pages[0], QuantPages) and self.chaos is not None:
+            dq = self.chaos.draw("page_dequant", self._stats["ticks"],
+                                 unit=req.id)
+            if dq is not None and dq.kind == "poison":
+                # Quantized twin of the float poison: int8 payloads are
+                # always finite, so corrupt the dequant SCALES — attention's
+                # in-kernel dequantize then propagates NaN and the same
+                # nonfinite-logits sentinel convicts the slot.
+                pages_d = jax.device_put(
+                    tuple(QuantPages(p.data, jnp.full_like(p.scale, jnp.nan))
+                          for p in pages),
+                    self._decode_sharding,
+                )
         return pages_d, delay_ticks
 
     def _drain_handoffs(self, drain_all: bool = False) -> None:
@@ -648,10 +669,10 @@ class DisaggServingEngine(ServingEngine):
         )
         self._hstats["inserts"] += 1
         if h.arm is not None:
-            tok, done0, carry = h.arm
+            tok, done0, carry, hist = h.arm
             self._state = self._arm(
                 self._state, np.int32(h.slot), tok, done0,
-                np.int32(h.budget), carry,
+                np.int32(h.budget), carry, hist,
             )
         if h.t0 is not None:
             jax.block_until_ready(k_page)
@@ -798,7 +819,8 @@ class DisaggServingEngine(ServingEngine):
                             dtype=self.config.cache_dtype),
             KVCache(cache_s, cache_s, vec_s))
         new_state = jax.device_put(
-            init_slot_state(self.n_slots, seed=self.config.seed),
+            init_slot_state(self.n_slots, seed=self.config.seed,
+                            history=self._spec_ngram),
             SlotState(*([vec_s] * len(SlotState._fields))))
         new_lane_params: dict[int, dict] = {}
         for v, p in new_params_by_version.items():
@@ -817,7 +839,8 @@ class DisaggServingEngine(ServingEngine):
                                       dtype=self.config.cache_dtype),
                       new_prefill[i % len(new_prefill)]),
                   state=jax.device_put(
-                      init_slot_state(1, seed=self.config.seed),
+                      init_slot_state(1, seed=self.config.seed,
+                                      history=self._spec_ngram),
                       new_prefill[i % len(new_prefill)]))
             for i in range(int(dc.n_prefill_lanes))
         ]
@@ -962,15 +985,16 @@ class DisaggServingEngine(ServingEngine):
                 start += valid
                 if j == len(chunks) - 1:
                     arm_args = jax.device_put(
-                        (tok, done0, lane.state.rng[0]), dsh)
+                        (tok, done0, lane.state.rng[0],
+                         lane.state.history[0]), dsh)
             if arm_args is not None:
-                tok, done0, carry = arm_args
+                tok, done0, carry, hist = arm_args
                 state = self._arm(state, np.int32(0), tok, done0,
-                                  np.int32(1), carry)
+                                  np.int32(1), carry, hist)
                 state = _release_step(state, np.int32(0))
         for _ in range(4 if mesh is not None else 1):
-            cache, state, _, _ = self._decode(params, cache, state,
-                                              self._full_mask)
+            cache, state, _, _, _ = self._decode(params, cache, state,
+                                                 self._full_mask)
         return cache, state
 
     def _drain_decode_tick(self) -> None:
@@ -989,11 +1013,11 @@ class DisaggServingEngine(ServingEngine):
                 for slot, r in L.decoding.items():
                     if r.weights_version == v:
                         mask[slot] = True
-                L.cache, L.state, tok, bad = self._decode(
+                L.cache, L.state, toks, emitted, bad = self._decode(
                     L.params_by_version[v], L.cache, L.state, mask)
                 self._stats["decode_steps"] += 1
-                tok_np, done_np, bad_np = jax.device_get(
-                    (tok, L.state.done, bad))
+                toks_np, emitted_np, done_np, bad_np = jax.device_get(
+                    (toks, emitted, L.state.done, bad))
                 for slot, req in list(L.decoding.items()):
                     if req.weights_version != v or not mask[slot]:
                         continue
@@ -1005,7 +1029,12 @@ class DisaggServingEngine(ServingEngine):
                             req, reason=("nonfinite logits while draining "
                                          f"layout {L.layout_id}"))
                         continue
-                    req.out.append(int(tok_np[slot]))
+                    cnt = int(emitted_np[slot])
+                    for t in toks_np[slot, :cnt]:
+                        req.out.append(int(t))
+                    if self._speculate_k > 0 and cnt > 0:
+                        req.spec_drafted += self._speculate_k
+                        req.spec_accepted += max(cnt - 1, 0)
                     if bool(done_np[slot]):
                         del L.decoding[slot]
                         self._finish(req, "ok")

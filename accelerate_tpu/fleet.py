@@ -368,6 +368,7 @@ class FleetRouter:
                     np.full((budget,), pad, np.int32)]),
                 "new_tokens": 0, "ttft_s": None, "tpot_s": None,
                 "weights_version": None, "attempt": 1, "recovered": False,
+                "drafted": 0, "accepted": 0,
                 "cell": None, "spilled": False, "drained_from": None,
             }
             self._requests[rid] = {"cid": eng_cid, "cell": None,
@@ -587,6 +588,8 @@ class FleetRouter:
                     "weights_version": trec.get("weights_version"),
                     "attempt": int(trec.get("attempt", 1)),
                     "recovered": True,
+                    "drafted": int(trec.get("drafted", 0)),
+                    "accepted": int(trec.get("accepted", 0)),
                     "cell": cell.name, "spilled": rec["spilled"],
                     "drained_from": cell.name,
                 }

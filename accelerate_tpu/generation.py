@@ -51,6 +51,42 @@ class KVCache(NamedTuple):
     length: jax.Array
 
 
+class QuantPages(NamedTuple):
+    """int8 KV pages with per-page absmax scales — the KV-cache twin of the
+    ``QuantizedTensor`` weight pattern (utils/quantization.py). Rides inside
+    ``KVCache.k``/``.v`` as a pytree subtree, so ``lax.scan`` over layers,
+    disagg page slicing, and ``device_put`` all work unchanged; attention
+    dequantizes adjacent to the dot (see ``_attend``) so pages cross HBM and
+    the disagg handoff link as int8 (~4x fewer bytes than bf16/fp32)."""
+
+    data: jax.Array   # int8, same layout as the float cache it replaces
+    scale: jax.Array  # f32, data.shape[:-1] + (1,) — one scale per page row
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def nbytes(self):
+        return self.data.nbytes + self.scale.nbytes
+
+
+def quantize_kv_page(x) -> QuantPages:
+    """Symmetric int8 quantization over the trailing (head_dim) axis."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, jnp.finfo(jnp.float32).tiny) / 127.0
+    data = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return QuantPages(data.astype(jnp.int8), scale)
+
+
+def dequantize_kv_page(pages: QuantPages, dtype):
+    return pages.data.astype(dtype) * pages.scale.astype(dtype)
+
+
 def _cache_dims(cfg) -> tuple[int, int, int, int]:
     """(layers, kv_heads, head_dim, max_positions) for any supported config.
     For encoder-decoder configs these describe the DECODER self-attention
@@ -76,6 +112,14 @@ def init_cache(cfg, batch: int, max_len: int, dtype=None) -> KVCache:
     layers, kv_heads, head_dim, _ = _cache_dims(cfg)
     shape = (layers, batch, max_len, kv_heads, head_dim)
     dtype = dtype or cfg.dtype
+    if np.dtype(dtype) == np.int8:
+        # Quantized KV pages: int8 data + per-page f32 scales (ones so an
+        # unwritten page dequantizes to exact zeros, like the float cache).
+        def _pages():
+            return QuantPages(jnp.zeros(shape, jnp.int8),
+                              jnp.ones(shape[:-1] + (1,), jnp.float32))
+        return KVCache(k=_pages(), v=_pages(),
+                       length=jnp.zeros((), jnp.int32))
     return KVCache(
         k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
         length=jnp.zeros((), jnp.int32),
@@ -103,7 +147,13 @@ def _cache_write(ck, k_new, start):
     """Write ``k_new`` (B, S, Hkv, D) into the cache slice ``ck``
     (B, T, Hkv, D) at row offset ``start`` — a scalar (one contiguous
     ``dynamic_update_slice``) or per-row vector (scatter at each row's own
-    offset, the slot-paged path)."""
+    offset, the slot-paged path). A ``QuantPages`` cache quantizes the new
+    pages here, writing data and scale leaves at the same offsets."""
+    if isinstance(ck, QuantPages):
+        q = quantize_kv_page(k_new)
+        return QuantPages(_cache_write(ck.data, q.data, start),
+                          _cache_write(ck.scale, q.scale, start))
+    k_new = k_new.astype(ck.dtype)
     if getattr(start, "ndim", 0) == 1:
         b, s = k_new.shape[:2]
         rows = jnp.arange(b, dtype=jnp.int32)[:, None]
@@ -202,7 +252,14 @@ def _attend(q, k, v, q_positions, kv_valid=None):
     """q (B,Sq,Hq,D) vs cached k/v (B,T,Hkv,D); causal wrt absolute cache
     slots. The causal bound kv_pos <= q_position also excludes unwritten
     cache slots (every query position is < cache length after the write).
-    ``kv_valid`` (B, T) additionally masks slots holding left-padding."""
+    ``kv_valid`` (B, T) additionally masks slots holding left-padding.
+    ``QuantPages`` k/v dequantize HERE — adjacent to the attention dots, the
+    same fusion-adjacency trick as ``_kernel`` — so the cache rides HBM as
+    int8 and XLA fuses convert×scale into the einsum."""
+    if isinstance(k, QuantPages):
+        k = dequantize_kv_page(k, q.dtype)
+    if isinstance(v, QuantPages):
+        v = dequantize_kv_page(v, q.dtype)
     hq, hkv = q.shape[2], k.shape[2]
     if hq != hkv:
         rep = hq // hkv
@@ -260,8 +317,8 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
         q, k_new, v_new = _qkv_proj(attn, hn, cos, sin, rotary_dim=rd)
         if attn_mult is not None:  # same q-folding trick as LlamaAttention
             q = q * jnp.asarray(attn_mult * np.sqrt(cfg.head_dim), q.dtype)
-        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
-        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
+        ck = _cache_write(ck, k_new, start)
+        cv = _cache_write(cv, v_new, start)
         out = _attend(q, ck, cv, positions, kv_valid)
         out = _out_proj(out, attn["o_proj"]["kernel"])
         if "bias" in attn["o_proj"]:
@@ -289,11 +346,11 @@ def _llama_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fal
 # ---------------------------------------------------------------------------
 
 
-def sample_logits(logits, rng, *, temperature=1.0, top_k: Optional[int] = None,
-                  top_p: Optional[float] = None):
-    """(B, V) fp32 logits → (B,) token ids. temperature<=0 means greedy."""
-    if temperature is None or temperature <= 0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, *, temperature, top_k: Optional[int] = None,
+                   top_p: Optional[float] = None):
+    """The temperature/top-k/top-p filtering half of :func:`sample_logits`,
+    shared bit-exactly with speculative accept/residual sampling (serving.py)
+    so both draw from the identical filtered distribution."""
     logits = logits / temperature
     if top_k is not None:
         top_k = min(top_k, logits.shape[-1])  # transformers clamps too
@@ -307,6 +364,16 @@ def sample_logits(logits, rng, *, temperature=1.0, top_k: Optional[int] = None,
         cutoff_idx = jnp.sum(cum < top_p, axis=-1)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def sample_logits(logits, rng, *, temperature=1.0, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """(B, V) fp32 logits → (B,) token ids. temperature<=0 means greedy."""
+    if temperature is None or temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits, temperature=temperature, top_k=top_k,
+                            top_p=top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -351,8 +418,8 @@ def _gpt2_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
             "bsh,hcnd->bscnd", hn, p["attn"]["c_attn"]["kernel"].astype(hn.dtype)
         ) + p["attn"]["c_attn"]["bias"].astype(hn.dtype)
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
-        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
+        ck = _cache_write(ck, k_new, start)
+        cv = _cache_write(cv, v_new, start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + (
             jnp.einsum("bsnd,ndh->bsh", out, p["attn"]["c_proj"]["kernel"].astype(out.dtype))
@@ -402,8 +469,8 @@ def _opt_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=False
         q = _proj(hn, attn["q_proj"]["kernel"]) + attn["q_proj"]["bias"].astype(hn.dtype)
         k_new = _proj(hn, attn["k_proj"]["kernel"]) + attn["k_proj"]["bias"].astype(hn.dtype)
         v_new = _proj(hn, attn["v_proj"]["kernel"]) + attn["v_proj"]["bias"].astype(hn.dtype)
-        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
-        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
+        ck = _cache_write(ck, k_new, start)
+        cv = _cache_write(cv, v_new, start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         h = h + _out_proj(out, attn["out_proj"]["kernel"]) + attn["out_proj"]["bias"].astype(h.dtype)
         hn = _layer_norm(h, p["final_layer_norm"], cfg.layer_norm_eps)
@@ -451,8 +518,8 @@ def _neox_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=Fals
         q, k_new, v_new = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         q = jnp.concatenate([apply_rope(q[..., :rnd], cos, sin), q[..., rnd:]], -1)
         k_new = jnp.concatenate([apply_rope(k_new[..., :rnd], cos, sin), k_new[..., rnd:]], -1)
-        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
-        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
+        ck = _cache_write(ck, k_new, start)
+        cv = _cache_write(cv, v_new, start)
         out = _attend(q, ck, cv, positions_b, kv_valid)
         attn_out = (
             jnp.einsum("bsnd,ndh->bsh", out, attn["dense"]["kernel"].astype(out.dtype))
@@ -533,8 +600,8 @@ def _mixtral_forward_cached(cfg, params, input_ids, cache: KVCache, return_all=F
         attn = p["self_attn"]
         hn = rms_norm(h, p["input_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)
         q, k_new, v_new = _qkv_proj(attn, hn, cos, sin)
-        ck = _cache_write(ck, k_new.astype(ck.dtype), start)
-        cv = _cache_write(cv, v_new.astype(cv.dtype), start)
+        ck = _cache_write(ck, k_new, start)
+        cv = _cache_write(cv, v_new, start)
         out = _attend(q, ck, cv, positions, kv_valid)
         h = h + _out_proj(out, attn["o_proj"]["kernel"])
         hn = rms_norm(h, p["post_attention_layernorm"]["weight"].astype(h.dtype), cfg.rms_norm_eps)

@@ -182,6 +182,13 @@ class BandwidthTable:
         link = self.ici_gbps if n_devices <= self.ici_domain else self.dcn_gbps
         return link * self.collective_efficiency
 
+    def kv_bytes_per_token(self, cfg, dtype=None) -> int:
+        """Dtype-aware KV footprint of one token (both K and V, all
+        layers). ``dtype`` overrides the config's cache dtype — pass the
+        actual page dtype (e.g. int8 quantized pages) so the handoff link
+        is priced on the bytes it really moves, not a hard-coded bf16."""
+        return kv_bytes_per_token(cfg, dtype=dtype)
+
 
 # ----------------------------------------------------------------------
 # Model profile (the divisibility constraints + roofline dims)
@@ -1113,12 +1120,17 @@ class DisaggSlicePlan:
 
 def kv_bytes_per_token(cfg, dtype=None) -> int:
     """Bytes one prompt token's committed K+V pages occupy across every
-    layer — the unit the handoff link is priced in."""
+    layer — the unit the handoff link is priced in. int8 pages carry one
+    f32 absmax scale per head per layer (QuantPages), included here so
+    the quantized handoff is priced on what actually moves."""
     from .generation import _cache_dims
 
     layers, kv_heads, head_dim, _ = _cache_dims(cfg)
-    itemsize = np.dtype(dtype or getattr(cfg, "dtype", np.float32)).itemsize
-    return 2 * layers * kv_heads * head_dim * itemsize
+    dt = np.dtype(dtype or getattr(cfg, "dtype", np.float32))
+    per_page = head_dim * dt.itemsize
+    if dt == np.int8:
+        per_page += 4  # the QuantPages f32 dequant scale
+    return 2 * layers * kv_heads * per_page
 
 
 def plan_disagg_slices(

@@ -99,6 +99,7 @@ from .generation import (
     GENERATION_PLANS,
     KVCache,
     _cache_dims,
+    _filter_logits,
     init_slot_cache,
     sample_logits,
 )
@@ -185,9 +186,15 @@ class SlotState(NamedTuple):
     generated: jax.Array   # (N,) int32 — new tokens sampled so far
     budget: jax.Array      # (N,) int32 — per-request max_new_tokens
     rng: jax.Array         # (N,) PRNG keys — one stream per request
+    # (N, H) int32 rolling token history (-1 pad), the n-gram self-draft
+    # window for speculative decoding. Invariant for armed slots:
+    # history[:, -1] == last_token. Inert (but still threaded/donated)
+    # when speculate_k == 0.
+    history: jax.Array
 
 
-def init_slot_state(n_slots: int, seed: int = 0) -> SlotState:
+def init_slot_state(n_slots: int, seed: int = 0,
+                    history: int = 16) -> SlotState:
     return SlotState(
         last_token=jnp.zeros((n_slots,), jnp.int32),
         active=jnp.zeros((n_slots,), bool),
@@ -195,6 +202,7 @@ def init_slot_state(n_slots: int, seed: int = 0) -> SlotState:
         generated=jnp.zeros((n_slots,), jnp.int32),
         budget=jnp.zeros((n_slots,), jnp.int32),
         rng=jax.random.split(jax.random.key(seed), n_slots),
+        history=jnp.full((n_slots, int(history)), -1, jnp.int32),
     )
 
 
@@ -221,12 +229,57 @@ def _select_keys(mask, a, b):
     return jax.random.wrap_key_data(data, impl=jax.random.key_impl(a))
 
 
-def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
+def _ngram_draft(history, last_token, k: int):
+    """Deterministic n-gram self-draft: find the most recent PREVIOUS
+    occurrence of ``last_token`` in each slot's history window and propose
+    the ``k`` tokens that followed it (cycling the followed suffix when it
+    is shorter than ``k``). Slots with no match (or -1 history padding)
+    fall back to repeating ``last_token`` — a valid, always-verifiable
+    draft. Pure jnp over static shapes: compiles into the decode program."""
+    h = history.shape[1]
+    match = history[:, : h - 1] == last_token[:, None]  # (N, H-1)
+    has = match.any(axis=1)
+    # Index of the LAST match: reverse, take the first True.
+    argrev = jnp.argmax(match[:, ::-1].astype(jnp.int32), axis=1)
+    j = jnp.where(has, (h - 2) - argrev, h - 1)
+    period = jnp.maximum((h - 1) - j, 1)
+    offs = j[:, None] + 1 + (jnp.arange(k, dtype=jnp.int32)[None, :]
+                             % period[:, None])
+    offs = jnp.minimum(offs, h - 1)
+    drafts = jnp.take_along_axis(history, offs, axis=1)  # (N, k)
+    return jnp.where(has[:, None] & (drafts >= 0), drafts,
+                     last_token[:, None])
+
+
+def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id,
+                       speculate_k: int = 0):
     """ONE jitted decode program for the whole engine lifetime: every slot
-    advances one token (rows that are free or done compute masked garbage —
-    the fixed shape is what buys zero steady-state recompiles). Cache and
-    state buffers are donated; params are NOT (the weight-publication hot
-    swap relies on rebinding them without invalidating live buffers).
+    advances one token — or, with ``speculate_k > 0``, up to ``k+1`` tokens
+    verified in one batched ``(n_slots, k+1)`` forward (rows that are free
+    or done compute masked garbage — the fixed shape is what buys zero
+    steady-state recompiles). Cache and state buffers are donated; params
+    are NOT (the weight-publication hot swap relies on rebinding them
+    without invalidating live buffers).
+
+    Both modes return the same 5-tuple
+    ``(cache, state, toks (N, k+1) int32, emitted (N,) int32, bad (N,))`` —
+    ``toks[slot, :emitted[slot]]`` are the tokens the slot really produced
+    this tick (k=0 returns ``(N, 1)`` with emitted == live).
+
+    Speculation: an n-gram self-draft proposes ``k`` tokens per slot from
+    the slot's token history; the target model scores all ``k+1`` window
+    positions in one forward. Greedy acceptance keeps the longest prefix
+    where draft == argmax, which makes the emitted token sequence
+    IDENTICAL (bit-equal) to the sequential greedy chain — a rejected
+    position's argmax is exactly what sequential decode would have
+    produced there. Sampled mode accepts draft ``d_i`` with probability
+    ``p_i(d_i)`` (the deterministic draft is a delta distribution, so the
+    standard min(1, p/q) ratio reduces to ``p_i(d_i)``) and on rejection
+    draws from the renormalized residual — the emitted tokens are
+    EXACTLY target-distribution samples. KV pages written past the
+    accepted prefix are garbage but harmless: the next tick's window
+    rewrites ``[start+e, start+e+k]`` bit-identically before attention
+    ever reads those rows.
 
     ``run_mask`` is a host-side (N,) bool vector selecting which slots this
     dispatch advances. Steady state passes all-True — one dispatch per tick,
@@ -237,43 +290,139 @@ def _build_decode_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
     budget accounting, and PRNG stream frozen (a masked live row's stale
     cache write at its frozen offset is overwritten by its owning dispatch
     before attention reads it — the same mechanism that parks done rows)."""
+    k_spec = int(speculate_k)
+    greedy = temperature is None or temperature <= 0
 
     def decode(params, cache: KVCache, state: SlotState, run_mask):
         live = state.active & ~state.done & run_mask
-        logits, new_cache = fwd(cfg, params, state.last_token[:, None], cache)
-        # fwd advanced every row's write offset; only live rows really did.
-        lengths = jnp.where(live, new_cache.length, cache.length)
-        pairs = jax.vmap(jax.random.split)(state.rng)  # (N, 2) keys
+        if k_spec == 0:
+            logits, new_cache = fwd(cfg, params, state.last_token[:, None],
+                                    cache)
+            # fwd advanced every row's write offset; only live rows really did.
+            lengths = jnp.where(live, new_cache.length, cache.length)
+            pairs = jax.vmap(jax.random.split)(state.rng)  # (N, 2) keys
+            carry, sub = pairs[:, 0], pairs[:, 1]
+            # Per-slot sampling over a (1, V) row — the same shape a batch-1
+            # generate() samples, so per-request streams match it exactly.
+            tok = jax.vmap(
+                lambda row, key: sample_logits(
+                    row[None], key, temperature=temperature, top_k=top_k,
+                    top_p=top_p
+                )[0]
+            )(logits, sub)
+            tok = jnp.where(live, tok, state.last_token)
+            # Nonfinite-logits sentinel: flag live rows whose logits went
+            # NaN/inf (a poisoned KV page). Computed on the PRE-update live
+            # mask so parked rows' masked garbage never flags, and fetched
+            # with the same host sync as (tok, done) — no extra dispatch
+            # stall.
+            bad = live & ~jnp.isfinite(logits).all(axis=-1)
+            generated = state.generated + live.astype(jnp.int32)
+            newly_done = live & (generated >= state.budget)
+            if eos_token_id is not None:
+                newly_done = newly_done | (live & (tok == eos_token_id))
+            new_state = SlotState(
+                last_token=tok,
+                active=state.active,
+                done=state.done | newly_done,
+                generated=generated,
+                budget=state.budget,
+                # Masked rows' streams must freeze (another version's
+                # dispatch owns their advance this tick); free/done slots'
+                # streams are dead until realloc rewrites them either way.
+                rng=_select_keys(live, carry, state.rng),
+                history=state.history,
+            )
+            return (KVCache(new_cache.k, new_cache.v, lengths), new_state,
+                    tok[:, None], live.astype(jnp.int32), bad)
+
+        # ---- speculative path: draft k, verify k+1 in ONE forward ----
+        n = state.last_token.shape[0]
+        drafts = _ngram_draft(state.history, state.last_token, k_spec)
+        window = jnp.concatenate([state.last_token[:, None], drafts], axis=1)
+        logits_all, new_cache = fwd(cfg, params, window, cache,
+                                    return_all=True)  # (N, k+1, V) fp32
+        bad = live & ~jnp.isfinite(logits_all).reshape(n, -1).all(axis=-1)
+        pairs = jax.vmap(jax.random.split)(state.rng)
         carry, sub = pairs[:, 0], pairs[:, 1]
-        # Per-slot sampling over a (1, V) row — the same shape a batch-1
-        # generate() samples, so per-request streams match it exactly.
-        tok = jax.vmap(
-            lambda row, key: sample_logits(
-                row[None], key, temperature=temperature, top_k=top_k, top_p=top_p
-            )[0]
-        )(logits, sub)
-        tok = jnp.where(live, tok, state.last_token)
-        # Nonfinite-logits sentinel: flag live rows whose logits went NaN/inf
-        # (a poisoned KV page). Computed on the PRE-update live mask so parked
-        # rows' masked garbage never flags, and fetched with the same host
-        # sync as (tok, done) — no extra dispatch stall.
-        bad = live & ~jnp.isfinite(logits).all(axis=-1)
-        generated = state.generated + live.astype(jnp.int32)
-        newly_done = live & (generated >= state.budget)
+        idx = jnp.arange(k_spec + 1, dtype=jnp.int32)[None, :]
+        if greedy:
+            # targets[:, i] is the sequential-greedy continuation of the
+            # window prefix ending at position i; the emitted prefix of
+            # targets is therefore the exact sequential greedy chain.
+            targets = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+            acc = jnp.cumprod(
+                (drafts == targets[:, :k_spec]).astype(jnp.int32), axis=1)
+            m = jnp.sum(acc, axis=1)  # accepted draft count, 0..k
+            out = targets
+        else:
+            vocab = logits_all.shape[-1]
+            flt = _filter_logits(logits_all.reshape(-1, vocab),
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
+            probs = jax.nn.softmax(flt, axis=-1).reshape(n, k_spec + 1, vocab)
+            keys = jax.vmap(
+                lambda key: jax.random.split(key, 2 * k_spec + 1))(sub)
+            u = jax.vmap(
+                lambda ks: jax.random.uniform(ks[0], (k_spec,)))(keys)
+            p_draft = jnp.take_along_axis(
+                probs[:, :k_spec], drafts[..., None], axis=-1)[..., 0]
+            acc = jnp.cumprod((u < p_draft).astype(jnp.int32), axis=1)
+            m = jnp.sum(acc, axis=1)
+            # Residual for a rejection at i: target probs with the draft
+            # token removed, renormalized. log(0)=-inf masks it out of the
+            # categorical. The bonus token (all k accepted) draws from the
+            # unmodified position-k distribution.
+            onehot = jax.nn.one_hot(drafts, vocab, dtype=bool)
+            resid = jnp.log(jnp.where(onehot, 0.0, probs[:, :k_spec]))
+            r_tok = jax.vmap(
+                lambda ks, lg: jax.vmap(jax.random.categorical)(
+                    ks[1:k_spec + 1], lg)
+            )(keys, resid).astype(jnp.int32)  # (N, k)
+            bonus = jax.vmap(
+                lambda ks, lg: jax.random.categorical(ks[2 * k_spec], lg)
+            )(keys, jnp.log(probs[:, k_spec])).astype(jnp.int32)  # (N,)
+            cand = jnp.concatenate([r_tok, bonus[:, None]], axis=1)
+            drafts_ext = jnp.concatenate(
+                [drafts, jnp.zeros((n, 1), jnp.int32)], axis=1)
+            out = jnp.where(idx < m[:, None], drafts_ext, cand)
+        # Emittable tokens this tick: the accepted prefix + one corrective/
+        # bonus token, clamped at the first EOS and the remaining budget.
+        avail = m + 1
         if eos_token_id is not None:
-            newly_done = newly_done | (live & (tok == eos_token_id))
+            is_eos = (out == eos_token_id) & (idx < avail[:, None])
+            any_eos = is_eos.any(axis=1)
+            first_eos = jnp.argmax(is_eos, axis=1)
+            avail = jnp.where(any_eos, first_eos + 1, avail)
+        room = jnp.maximum(state.budget - state.generated, 0)
+        e = jnp.where(live, jnp.minimum(avail, room), 0)
+        generated = state.generated + e
+        newly_done = live & (e > 0) & (generated >= state.budget)
+        if eos_token_id is not None:
+            newly_done = newly_done | (
+                live & (is_eos & (idx < e[:, None])).any(axis=1))
+        last = jnp.take_along_axis(
+            out, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+        tok_last = jnp.where(live & (e > 0), last, state.last_token)
+        # Only the accepted prefix really advanced the cache; the garbage
+        # KV past it is rewritten bit-identically next tick.
+        lengths = jnp.where(live, cache.length + e, cache.length)
+        # Shift the e emitted tokens into the history window.
+        h = state.history.shape[1]
+        buf = jnp.concatenate([state.history, out], axis=1)
+        hist = jnp.take_along_axis(
+            buf, jnp.arange(h, dtype=jnp.int32)[None, :] + e[:, None], axis=1)
         new_state = SlotState(
-            last_token=tok,
+            last_token=tok_last,
             active=state.active,
             done=state.done | newly_done,
             generated=generated,
             budget=state.budget,
-            # Masked rows' streams must freeze (another version's dispatch
-            # owns their advance this tick); free/done slots' streams are
-            # dead until realloc rewrites them either way.
             rng=_select_keys(live, carry, state.rng),
+            history=hist,
         )
-        return KVCache(new_cache.k, new_cache.v, lengths), new_state, tok, bad
+        return (KVCache(new_cache.k, new_cache.v, lengths), new_state,
+                out, e, bad)
 
     return jax.jit(decode, donate_argnums=(1, 2))
 
@@ -287,14 +436,25 @@ def _build_prefill_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
     def prefill(params, cache: KVCache, state: SlotState, chunk, slot, valid,
                 budget, rng, is_first, is_final):
         start = jnp.where(is_first, 0, cache.length[slot])
+        # tree.map: a float cache is a single array per side; quantized KV
+        # pages (QuantPages) are a data+scale subtree with the slot axis in
+        # the same position on both leaves.
         sub_cache = KVCache(
-            jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1),
-            jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1),
+            jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1),
+                cache.k),
+            jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1),
+                cache.v),
             start[None],  # (1,) per-row vector — the slot-paged fwd path
         )
         logits_all, sub_cache = fwd(cfg, params, chunk, sub_cache, return_all=True)
-        k = jax.lax.dynamic_update_slice_in_dim(cache.k, sub_cache.k, slot, axis=1)
-        v = jax.lax.dynamic_update_slice_in_dim(cache.v, sub_cache.v, slot, axis=1)
+        k = jax.tree.map(
+            lambda a, s: jax.lax.dynamic_update_slice_in_dim(a, s, slot, axis=1),
+            cache.k, sub_cache.k)
+        v = jax.tree.map(
+            lambda a, s: jax.lax.dynamic_update_slice_in_dim(a, s, slot, axis=1),
+            cache.v, sub_cache.v)
         # Advance by the VALID tokens only; a padded tail is overwritten by
         # the next write and never attended (causal bound at true length).
         lengths = cache.length.at[slot].set(start + valid)
@@ -308,6 +468,18 @@ def _build_prefill_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
         if eos_token_id is not None:
             done0 = done0 | (tok == eos_token_id)
         done0 = is_final & done0
+        # Seed the slot's n-gram history: shift the chunk's VALID tokens in
+        # (first chunk resets the window to -1 padding first), and on the
+        # final chunk shift in the sampled first token so the armed-slot
+        # invariant history[:, -1] == last_token holds entering decode.
+        h = state.history.shape[1]
+        hist0 = jnp.where(is_first,
+                          jnp.full((h,), -1, jnp.int32),
+                          state.history[slot])
+        hbuf = jnp.concatenate([hist0, chunk[0].astype(jnp.int32)])
+        hist1 = jax.lax.dynamic_slice_in_dim(hbuf, valid, h)
+        hist2 = jnp.where(is_final,
+                          jnp.concatenate([hist1[1:], tok[None]]), hist1)
         new_state = SlotState(
             # Intermediate chunks park a garbage token here; the final chunk
             # (the only one decode can observe — active stays False until
@@ -319,6 +491,7 @@ def _build_prefill_step(fwd, cfg, temperature, top_k, top_p, eos_token_id):
                 jnp.where(is_final, 1, 0).astype(jnp.int32)),
             budget=state.budget.at[slot].set(budget),
             rng=state.rng.at[slot].set(carry),
+            history=state.history.at[slot].set(hist2),
         )
         return KVCache(k, v, lengths), new_state, tok, done0
 
@@ -337,6 +510,7 @@ def _release_slot_op(state: SlotState, slot) -> SlotState:
         generated=state.generated,
         budget=state.budget,
         rng=state.rng,
+        history=state.history,
     )
 
 
@@ -386,7 +560,7 @@ class _Request:
         "id", "tokens", "budget", "rng", "slot", "lane", "chunks", "next_chunk",
         "consumed", "out", "submit_t", "admit_t", "first_token_t", "done_t",
         "deadline", "retries", "status", "weights_version", "canary", "layout",
-        "client_request_id", "recoveries",
+        "client_request_id", "recoveries", "spec_drafted", "spec_accepted",
     )
 
     def __init__(self, rid, tokens, budget, rng):
@@ -412,6 +586,8 @@ class _Request:
         self.layout = None            # topology generation bound at grant
         self.client_request_id = None  # caller's idempotency key (journal)
         self.recoveries = 0           # crash-restart replays (no retry spend)
+        self.spec_drafted = 0         # draft tokens proposed for this request
+        self.spec_accepted = 0        # draft tokens accepted (emitted early)
 
     def reset_for_retry(self) -> None:
         """Back to freshly-queued: prompt, budget, rng, deadline, the
@@ -426,6 +602,8 @@ class _Request:
         self.out = []
         self.admit_t = None
         self.first_token_t = None
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
 
 class ServingEngine:
@@ -532,8 +710,11 @@ class ServingEngine:
         self.pad_token_id = c.pad_token_id if c.pad_token_id is not None else (
             eos if eos is not None else 0
         )
+        self._speculate_k = int(getattr(c, "speculate_k", 0) or 0)
+        self._spec_ngram = int(getattr(c, "speculate_ngram", 16) or 16)
         self._decode = _build_decode_step(
-            fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos
+            fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos,
+            speculate_k=self._speculate_k,
         )
         self._prefill = _build_prefill_step(
             fwd, self.cfg, c.temperature, c.top_k, c.top_p, eos
@@ -549,7 +730,9 @@ class ServingEngine:
         self._cache = _commit_params(init_slot_cache(
             self.cfg, self.n_slots, self.t_max, dtype=c.cache_dtype
         ))
-        self._state = _commit_params(init_slot_state(self.n_slots, seed=c.seed))
+        self._state = _commit_params(init_slot_state(
+            self.n_slots, seed=c.seed,
+            history=self._spec_ngram))
         # The param tree the dispatch hooks feed the jitted programs. The
         # disaggregated router (disagg.py) repoints this at the decode-mesh
         # copy; the colocated engine uses the model's own placement.
@@ -600,6 +783,11 @@ class ServingEngine:
             "slot_allocs": 0, "slot_reuses": 0, "occupancy_sum": 0,
             "peak_occupancy": 0, "queue_depth_sum": 0, "queue_samples": 0,
             "steady_recompiles": 0,
+            # Speculative-decoding counters (stats()["speculation"] block +
+            # the hub's accelerate_tpu_spec_* series). All zero when
+            # speculate_k == 0.
+            "spec_drafted": 0, "spec_accepted": 0, "spec_decode_tokens": 0,
+            "spec_verify_s": 0.0,
         }
         # Robustness state: fault counters (the telemetry "faults" block),
         # quarantined slots (poisoned rows taken out of rotation), the
@@ -612,6 +800,7 @@ class ServingEngine:
         }
         self._quarantined_slots: set[int] = set()
         self._poison_op = None       # lazily jitted chaos-only program
+        self._spoil_op = None        # lazily jitted draft_mismatch program
         self._draining = False
         self._idle_ticks = 0
         # Per-tick fused-fetch wall accumulator (profiler host_fetch_s
@@ -635,6 +824,8 @@ class ServingEngine:
             telemetry, "hub", None)
         if self._hub is not None:
             self._hub.register_slo("serving_availability", 0.99)
+            self._hub.register_provider(
+                "spec", self._spec_metrics, replace=True)
             if self._journal is not None:
                 self._hub.register_provider(
                     "journal", self._journal.stats, replace=True)
@@ -1078,16 +1269,27 @@ class ServingEngine:
                 # wrong. Only the decode canary (sdc.py) can see it.
                 flip_slot = int((fault.extra or {}).get(
                     "slot", min(self._decoding)))
+            if self._speculate_k > 0:
+                fault = self.chaos.draw("draft_mismatch",
+                                        self._stats["ticks"])
+                if fault is not None and fault.kind == "poison":
+                    # Spoil one slot's n-gram history: its drafts degenerate
+                    # (repeat-last-token fallback) so acceptance collapses,
+                    # but verification keeps the OUTPUT bit-equal — the
+                    # property the chaos smoke asserts.
+                    self._spoil_history(min(self._decoding))
         live = len(self._decoding)
         self._stats["occupancy_sum"] += live
         self._stats["peak_occupancy"] = max(self._stats["peak_occupancy"], live)
         tr = self.tracing
+        k_spec = self._speculate_k
         for version, mask in self._decode_groups():
+            t0 = time.perf_counter() if (tr is not None
+                                         or k_spec > 0) else None
             if tr is not None:
-                t0 = time.perf_counter()
                 group_rids = [r.id for s, r in self._decoding.items()
                               if r.weights_version == version and mask[s]]
-            self._cache, self._state, tok, bad = self._decode(
+            self._cache, self._state, toks, emitted, bad = self._decode(
                 self._params_for(version), self._cache, self._state, mask
             )
             self._stats["decode_steps"] += 1
@@ -1097,43 +1299,61 @@ class ServingEngine:
                 # mid-flight growth lands as a "recompile" event in the
                 # telemetry JSONL.
                 try:
-                    self.telemetry._watch_recompiles(self._decode, tok)
+                    self.telemetry._watch_recompiles(self._decode, toks)
                 except Exception:
                     pass
-            # The per-tick host sync: fetch this round's tokens + done flags
-            # + the nonfinite sentinel (one fused device_get — no extra
-            # stall). Under a mixed-version tick this runs once per group,
-            # reading only the rows that group's mask advanced. The
+            # The per-tick host sync: fetch this round's tokens (a (N, k+1)
+            # block under speculation) + per-slot emitted counts + done
+            # flags + the nonfinite sentinel (one fused device_get — no
+            # extra stall). Under a mixed-version tick this runs once per
+            # group, reading only the rows that group's mask advanced. The
             # profiler times THIS existing sync (it never adds one): the
             # fetch wall is the tick's host_fetch_s attribution term.
             if self._profiler is not None:
                 tf0 = time.perf_counter()
-            tok_np, done_np, bad_np = jax.device_get(
-                (tok, self._state.done, bad))
+            toks_np, emitted_np, done_np, bad_np = jax.device_get(
+                (toks, emitted, self._state.done, bad))
             if self._profiler is not None:
                 self._tick_fetch_s += time.perf_counter() - tf0
             if flip_slot is not None and mask[flip_slot]:
-                tok_np = np.array(tok_np)
-                tok_np[flip_slot] ^= 1
+                toks_np = np.array(toks_np)
+                toks_np[flip_slot, 0] ^= 1
                 flip_slot = None  # one flip per tick, not per version group
+            group_drafted = group_accepted = 0
             for slot, req in list(self._decoding.items()):
                 if req.weights_version != version or not mask[slot]:
                     continue
                 if bool(bad_np[slot]):
                     self._on_poisoned_slot(slot, req)
                     continue
-                req.out.append(int(tok_np[slot]))
-                if (self._journal is not None
-                        and not self._journal_suppressed(req.id)):
-                    self._journal_tokens.setdefault(req.id, []).append(
-                        req.out[-1])
+                cnt = int(emitted_np[slot])
+                for t in toks_np[slot, :cnt]:
+                    req.out.append(int(t))
+                    if (self._journal is not None
+                            and not self._journal_suppressed(req.id)):
+                        self._journal_tokens.setdefault(req.id, []).append(
+                            req.out[-1])
+                if k_spec > 0:
+                    req.spec_drafted += k_spec
+                    req.spec_accepted += max(cnt - 1, 0)
+                    group_drafted += k_spec
+                    group_accepted += max(cnt - 1, 0)
+                    self._stats["spec_decode_tokens"] += cnt
                 if bool(done_np[slot]):
                     del self._decoding[slot]
                     self._retire(req)
+            if k_spec > 0:
+                self._stats["spec_drafted"] += group_drafted
+                self._stats["spec_accepted"] += group_accepted
+                # Per-tick verify-time attribution: the whole speculative
+                # dispatch IS the k+1-position verification forward.
+                self._stats["spec_verify_s"] += time.perf_counter() - t0
             if tr is not None:
                 tr.decode_tick(self._stats["ticks"], t0, time.perf_counter(),
                                weights_version=version, occupancy=live,
-                               n_slots=self.n_slots, request_ids=group_rids)
+                               n_slots=self.n_slots, request_ids=group_rids,
+                               drafted=group_drafted,
+                               accepted=group_accepted)
         size = _cache_size(self._decode)
         if size is not None:
             if self._decode_executables_baseline is None:
@@ -1203,6 +1423,7 @@ class ServingEngine:
             "ttft_s": ttft, "tpot_s": tpot,
             "weights_version": req.weights_version,
             "attempt": attempt, "recovered": req.recoveries > 0,
+            "drafted": req.spec_drafted, "accepted": req.spec_accepted,
         }
         self._finished.append(result)
         if req.client_request_id is not None:
@@ -1221,13 +1442,15 @@ class ServingEngine:
                 "ttft_s": ttft, "tpot_s": tpot,
                 "weights_version": req.weights_version,
                 "attempt": attempt, "t_mono": req.done_t,
+                "drafted": req.spec_drafted, "accepted": req.spec_accepted,
             }, tick=self._stats["ticks"], unit=req.id)
         if len(self._params_by_version) > 1:
             self._gc_versions()
         if self.tracing is not None:
             self.tracing.request_finished(
                 req.id, self._stats["ticks"], req.done_t, status=status,
-                new_tokens=n_new, weights_version=req.weights_version)
+                new_tokens=n_new, weights_version=req.weights_version,
+                drafted=req.spec_drafted, accepted=req.spec_accepted)
         if self.telemetry is not None:
             self.telemetry.record_event(
                 "serving_request_done", request_id=req.id, status=status,
@@ -1363,6 +1586,17 @@ class ServingEngine:
                 )
             self._poison_op = jax.jit(poison, donate_argnums=(0,))
         self._cache = self._poison_op(self._cache, np.int32(slot))
+
+    def _spoil_history(self, slot: int) -> None:
+        """Chaos-only (``draft_mismatch``): blank one slot's n-gram history
+        so its self-drafts degenerate — acceptance collapses while the
+        verified OUTPUT stays bit-equal. A separate lazily-jitted program,
+        like :meth:`_poison_slot`, so the decode census is untouched."""
+        if self._spoil_op is None:
+            def spoil(state: SlotState, slot):
+                return state._replace(history=state.history.at[slot].set(-1))
+            self._spoil_op = jax.jit(spoil, donate_argnums=(0,))
+        self._state = self._spoil_op(self._state, np.int32(slot))
 
     # -- crash durability (the journal.py write-ahead log) -----------------
 
@@ -1519,6 +1753,8 @@ class ServingEngine:
                     "weights_version": trec.get("weights_version"),
                     "attempt": int(trec.get("attempt", 1)),
                     "recovered": True,
+                    "drafted": int(trec.get("drafted", 0)),
+                    "accepted": int(trec.get("accepted", 0)),
                 }
                 self._finished.append(result)
                 self._cached_rows[rid] = result
@@ -2046,8 +2282,44 @@ class ServingEngine:
             "window": self.window_stats(),
             "faults": self.fault_stats(),
             "journal": self.journal_stats(),
+            "speculation": self.speculation_stats(),
         }
         return out
+
+    def speculation_stats(self) -> dict:
+        """The ``speculation`` telemetry block: draft/accept counters and
+        the derived acceptance rate + tokens-per-tick. Present even with
+        speculation off (``k == 0``) so the schema is stable."""
+        s = self._stats
+        drafted = int(s["spec_drafted"])
+        accepted = int(s["spec_accepted"])
+        steps = int(s["decode_steps"])
+        return {
+            "k": self._speculate_k,
+            "ngram": self._spec_ngram,
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance_rate": (
+                round(accepted / drafted, 6) if drafted else None
+            ),
+            "tokens_per_tick": (
+                round(s["spec_decode_tokens"] / steps, 6) if steps else None
+            ),
+            "verify_time_s": round(float(s["spec_verify_s"]), 6),
+        }
+
+    def _spec_metrics(self) -> dict:
+        """MetricsHub provider: flat numeric ``accelerate_tpu_spec_*``
+        gauges (hub names are a schema; None becomes 0.0)."""
+        sp = self.speculation_stats()
+        return {
+            "k": float(sp["k"]),
+            "drafted": float(sp["drafted"]),
+            "accepted": float(sp["accepted"]),
+            "acceptance_rate": float(sp["acceptance_rate"] or 0.0),
+            "tokens_per_tick": float(sp["tokens_per_tick"] or 0.0),
+            "verify_time_s": float(sp["verify_time_s"]),
+        }
 
     def journal_stats(self) -> Optional[dict]:
         """The ``journal`` telemetry block: WAL counters (appends, syncs,

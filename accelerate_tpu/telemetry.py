@@ -177,6 +177,8 @@ class TelemetryRecorder:
         # record_serving and it rides the summary as the "serving" block.
         self._serving_summary: Optional[dict] = None
         self._serving_requests = 0
+        # Speculative-decoding acceptance-rate EMA across summary pushes.
+        self._spec_accept_ema: Optional[float] = None
         # Elastic reshard block (resharding.py): cumulative leaves/bytes/
         # depth/wall time across restores and live migrations this run.
         self._reshard_summary: Optional[dict] = None
@@ -674,6 +676,24 @@ class TelemetryRecorder:
         TTFT/TPOT percentiles, queue depth, slot occupancy, tokens/s,
         steady-state recompile census. Last push wins."""
         self._serving_summary = dict(block)
+        spec = self._serving_summary.get("speculation")
+        if isinstance(spec, dict):
+            rate = spec.get("acceptance_rate")
+            if rate is not None:
+                # Cross-push EMA: single stats() pushes are noisy on short
+                # windows; the EMA is the number the autoscaler / perf
+                # trajectory should trend on.
+                prev = self._spec_accept_ema
+                self._spec_accept_ema = (
+                    float(rate) if prev is None
+                    else 0.9 * prev + 0.1 * float(rate)
+                )
+            spec = dict(spec)
+            spec["acceptance_rate_ema"] = (
+                round(self._spec_accept_ema, 6)
+                if self._spec_accept_ema is not None else None
+            )
+            self._serving_summary["speculation"] = spec
         self._write({
             "event": "serving_summary", "step": self.step, "time": time.time(),
             **self._serving_summary,

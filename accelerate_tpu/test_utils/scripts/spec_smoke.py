@@ -1,0 +1,218 @@
+"""`make spec-smoke`: speculative decoding + quantized KV pages, end to end.
+
+A seeded 24-request mixed-length trace through a tiny Llama, four times:
+
+- **reference** — :class:`ServingEngine`, plain one-token-per-tick greedy
+  decode (``speculate_k=0``, model-dtype KV cache);
+- **speculative** — the same trace with n-gram self-drafting on
+  (``speculate_k=4``): the drafter proposes 4 tokens per slot per tick and
+  the target model verifies all 5 positions in ONE batched forward inside
+  the same jitted decode program;
+- **int8 colocated** — plain decode again, but with ``cache_dtype=int8``
+  (QuantPages: per-page absmax scales, dequantized inside attention);
+- **int8 disagg + speculative** — both features at once through the
+  two-mesh :class:`DisaggServingEngine` (quantized KV-page handoff).
+
+Asserts:
+
+- speculative greedy output is BIT-EQUAL to the non-speculative reference
+  (exact-distribution verification: a rejected draft position's argmax is
+  the token sequential decode would have emitted);
+- the decode steady state stays ONE executable with zero post-warmup
+  recompiles — with speculation on, and with speculation AND int8 KV on;
+- the speculation stats block reports real drafting (drafted > 0,
+  acceptance_rate populated);
+- int8-KV disagg rows are BIT-EQUAL to int8 colocated rows (the quantized
+  handoff moves int8 pages + scales verbatim — no second quantization);
+- int8 greedy output stays close to the float reference: mean per-token
+  agreement >= 0.70 over the trace. (Documented tolerance: int8 KV
+  perturbs logits by ~1e-2; greedy argmax flips at near-ties and the
+  trajectory then diverges, so whole-sequence bit-equality across DTYPES
+  is not the contract — within-dtype bit-equality is.)
+- the disagg handoff byte accounting prices int8 pages at least 40% below
+  the planner's model-dtype estimate for the same token traffic.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_REQUESTS = 24
+N_SLOTS = 8
+SPEC_K = 4
+NGRAM = 16
+MIN_INT8_AGREEMENT = 0.70  # documented cross-dtype tolerance (see module doc)
+MIN_BYTES_SAVED = 0.40
+
+
+def main():
+    print(json.dumps({"row": "start", "requests": N_REQUESTS, "k": SPEC_K}),
+          flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import (
+        DisaggConfig,
+        DisaggServingEngine,
+        Model,
+        ServingConfig,
+        ServingEngine,
+    )
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.planner import kv_bytes_per_token
+    from accelerate_tpu.utils import set_seed
+
+    set_seed(0)
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="native")
+    module = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    probe = rng.integers(0, cfg.vocab_size, (1, 8), dtype=np.int32)
+    model = Model.from_flax(module, jax.random.key(0), probe)
+
+    lengths = rng.integers(3, 40, N_REQUESTS)
+    budgets = rng.integers(8, 48, N_REQUESTS).astype(int)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, (int(n),), dtype=np.int32)
+        for n in lengths
+    ]
+    useful_tokens = int(budgets.sum())
+
+    def run(scfg, disagg=None):
+        eng = (ServingEngine(model, scfg) if disagg is None
+               else DisaggServingEngine(model, scfg, disagg=disagg))
+        t0 = time.perf_counter()
+        outs = eng.run([p.copy() for p in prompts],
+                       max_new_tokens=[int(b) for b in budgets])
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        eng.close()
+        rows = [
+            np.asarray(outs[i][len(prompts[i]):len(prompts[i]) + int(budgets[i])])
+            for i in range(N_REQUESTS)
+        ]
+        return rows, st, wall
+
+    base = dict(n_slots=N_SLOTS, max_len=96)
+
+    # --- Phase 1: non-speculative reference -------------------------------
+    ref_rows, ref_st, ref_s = run(ServingConfig(**base))
+    print(json.dumps({
+        "row": "reference", "seconds": round(ref_s, 3),
+        "tokens_per_s": round(useful_tokens / ref_s, 2),
+        "decode_steps": ref_st["decode_steps"],
+    }), flush=True)
+
+    # --- Phase 2: speculation on ------------------------------------------
+    spec_rows, spec_st, spec_s = run(
+        ServingConfig(**base, speculate_k=SPEC_K, speculate_ngram=NGRAM))
+    spec = spec_st["speculation"]
+    print(json.dumps({
+        "row": "speculative", "seconds": round(spec_s, 3),
+        "tokens_per_s": round(useful_tokens / spec_s, 2),
+        "decode_steps": spec_st["decode_steps"],
+        "decode_executables": spec_st["decode_executables"],
+        "steady_recompiles": spec_st["steady_recompiles"],
+        "speculation": spec,
+    }), flush=True)
+
+    mismatched = [
+        i for i in range(N_REQUESTS)
+        if not np.array_equal(spec_rows[i], ref_rows[i])
+    ]
+    assert not mismatched, (
+        f"speculative != reference for requests {mismatched}"
+    )
+    assert spec_st["decode_executables"] == 1, (
+        f"speculation compiled {spec_st['decode_executables']} decode "
+        "executables, want 1"
+    )
+    assert spec_st["steady_recompiles"] == 0, (
+        f"{spec_st['steady_recompiles']} steady recompiles with speculation on"
+    )
+    assert spec["drafted"] > 0 and spec["acceptance_rate"] is not None, (
+        f"speculation stats never populated: {spec}"
+    )
+    assert spec_st["decode_steps"] < ref_st["decode_steps"], (
+        f"speculation took {spec_st['decode_steps']} decode steps vs "
+        f"reference {ref_st['decode_steps']} — accepted drafts saved nothing"
+    )
+
+    # --- Phase 3: int8 KV, colocated --------------------------------------
+    i8_rows, i8_st, _ = run(ServingConfig(**base, cache_dtype=jnp.int8))
+    agree = float(np.mean([
+        np.mean(i8_rows[i] == ref_rows[i]) for i in range(N_REQUESTS)
+    ]))
+    print(json.dumps({
+        "row": "int8_colocated",
+        "token_agreement_vs_f32": round(agree, 4),
+        "decode_executables": i8_st["decode_executables"],
+    }), flush=True)
+    assert agree >= MIN_INT8_AGREEMENT, (
+        f"int8 KV agreement {agree:.3f} < {MIN_INT8_AGREEMENT} vs float "
+        "reference — quantization error beyond documented tolerance"
+    )
+
+    # --- Phase 4: int8 KV disagg + speculation, quantized handoff ---------
+    if len(jax.devices()) < 2:
+        print(json.dumps({"row": "skip", "reason": "needs >= 2 devices"}),
+              flush=True)
+        return 0
+    i8s_rows, _, _ = run(
+        ServingConfig(**base, cache_dtype=jnp.int8,
+                      speculate_k=SPEC_K, speculate_ngram=NGRAM))
+    d_rows, d_st, _ = run(
+        ServingConfig(**base, cache_dtype=jnp.int8,
+                      speculate_k=SPEC_K, speculate_ngram=NGRAM),
+        disagg=DisaggConfig(n_prefill_lanes=2))
+    moved = int(d_st["disagg"]["handoff_bytes"])
+    per_q = kv_bytes_per_token(cfg, dtype=jnp.int8)
+    per_f = kv_bytes_per_token(cfg)
+    unq_est = int(round(moved * per_f / per_q))
+    saved = 1.0 - moved / unq_est
+    print(json.dumps({
+        "row": "int8_disagg_speculative",
+        "decode_executables": d_st["decode_executables"],
+        "steady_recompiles": d_st["steady_recompiles"],
+        "handoff_bytes": moved,
+        "handoff_bytes_unquantized_est": unq_est,
+        "bytes_saved_pct": round(100.0 * saved, 2),
+        "speculation": d_st["speculation"],
+    }), flush=True)
+
+    mismatched = [
+        i for i in range(N_REQUESTS)
+        if not np.array_equal(d_rows[i], i8s_rows[i])
+    ]
+    assert not mismatched, (
+        f"int8 disagg != int8 colocated for requests {mismatched} — the "
+        "quantized handoff is not lossless"
+    )
+    assert d_st["decode_executables"] == 1, (
+        f"disagg decode compiled {d_st['decode_executables']} executables "
+        "with speculation + int8 KV, want 1"
+    )
+    assert d_st["steady_recompiles"] == 0, (
+        f"{d_st['steady_recompiles']} steady recompiles with speculation + "
+        "int8 KV"
+    )
+    assert moved > 0, "disagg run reported zero handoff traffic"
+    assert saved >= MIN_BYTES_SAVED, (
+        f"int8 handoff saved only {100 * saved:.1f}% vs model-dtype "
+        f"estimate, want >= {100 * MIN_BYTES_SAVED:.0f}%"
+    )
+
+    print(json.dumps({
+        "row": "ok",
+        "spec_bit_equal": True,
+        "int8_disagg_bit_equal": True,
+        "acceptance_rate": spec["acceptance_rate"],
+        "bytes_saved_pct": round(100.0 * saved, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

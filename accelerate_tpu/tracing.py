@@ -183,6 +183,7 @@ class _ReqTrace:
         "queue_wait_s", "prefill_active_s", "handoff_s", "backoff_s",
         "decode_ticks", "retries", "prompt_tokens", "new_tokens",
         "weights_version", "canary", "lanes", "slot", "ttft_s",
+        "drafted", "accepted",
     )
 
     def __init__(self, rid, tick, t, prompt_tokens, deadline_s):
@@ -209,6 +210,8 @@ class _ReqTrace:
         self.lanes = []
         self.slot = None
         self.ttft_s = None
+        self.drafted = 0
+        self.accepted = 0
 
 
 class TraceRecorder:
@@ -431,12 +434,18 @@ class TraceRecorder:
     def decode_tick(self, tick: int, t0: Optional[float],
                     t1: Optional[float], *, weights_version: int,
                     occupancy: int, n_slots: int,
-                    request_ids=()) -> None:
+                    request_ids=(), drafted: int = 0,
+                    accepted: int = 0) -> None:
+        attrs = {"weights_version": weights_version,
+                 "occupancy": occupancy, "n_slots": n_slots}
+        if drafted:
+            # Speculation attribution: how many draft tokens this tick's
+            # single verify forward covered and how many survived.
+            attrs["drafted"] = drafted
+            attrs["accepted"] = accepted
         span = self._new_span(
             "decode", f"decode v{weights_version}", "decode_tick", tick,
-            tid="decode", t=t0,
-            attrs={"weights_version": weights_version,
-                   "occupancy": occupancy, "n_slots": n_slots})
+            tid="decode", t=t0, attrs=attrs)
         if span is not None:
             span.end_tick = tick
             span.t1 = t1
@@ -463,7 +472,8 @@ class TraceRecorder:
 
     def request_finished(self, rid: int, tick: int, t: Optional[float], *,
                          status: str, new_tokens: int,
-                         weights_version: int) -> None:
+                         weights_version: int, drafted: int = 0,
+                         accepted: int = 0) -> None:
         rt = self._touch_request(rid)
         if rt is not None:
             rt.done_t = t
@@ -471,6 +481,13 @@ class TraceRecorder:
             rt.status = status
             rt.new_tokens = new_tokens
             rt.weights_version = weights_version
+            rt.drafted = drafted
+            rt.accepted = accepted
+            if drafted:
+                self.instant("decode", "speculation", tick, tid="decode",
+                             request_id=rid, drafted=drafted,
+                             accepted=accepted,
+                             rejected=drafted - accepted)
         # A request shed/failed while queued still holds an open span.
         span = self._open_req.pop(rid, None)
         if span is not None:
@@ -673,6 +690,13 @@ class TraceRecorder:
             "total_s": None,
             "deadline_s": rt.deadline_s,
             "deadline_missed": None,
+            # Speculation is a decode-phase property, not a TTFT term:
+            # accepted drafts shorten decode_s, never the TTFT window.
+            "speculation": (
+                {"drafted": rt.drafted, "accepted": rt.accepted,
+                 "rejected": rt.drafted - rt.accepted}
+                if rt.drafted else None
+            ),
         }
         if rt.first_token_t is not None and rt.submit_t is not None:
             ttft = rt.first_token_t - rt.submit_t

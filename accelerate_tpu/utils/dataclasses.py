@@ -661,8 +661,22 @@ class ServingConfig(KwargsHandler):
       step bakes them in). ``max_new_tokens`` is the default per-request
       budget; ``submit``/``run`` override it per request.
     - ``cache_dtype``: KV-cache dtype override (default: model dtype).
+      ``jnp.int8`` switches the slot cache to quantized KV pages
+      (``generation.QuantPages``: int8 data + per-page absmax scales) —
+      attention dequantizes in-kernel and disagg handoff moves ~4x fewer
+      bytes; see docs/usage_guides/serving.md "Quantized KV pages".
     - ``seed``: seeds the idle slots' PRNG pool; each request's stream is
       the ``rng`` passed at ``submit`` (default ``jax.random.key(0)``).
+    - ``speculate_k``: speculative decoding — self-draft ``k`` tokens per
+      slot per tick from an n-gram history match and verify all ``k+1``
+      positions in ONE batched forward inside the same single jitted
+      decode program (static ``(n_slots, k+1)`` shapes, so the
+      zero-recompile invariant holds). ``0`` (default) keeps the plain
+      one-token tick. Greedy output is bit-equal to non-speculative
+      decode; sampled output draws through exact-distribution rejection
+      sampling. See docs/usage_guides/serving.md "Speculative decoding".
+    - ``speculate_ngram``: per-slot token-history window the self-draft
+      matches against (the draft "model" capacity; >= 2).
 
     Admission control + SLOs (every request terminates with an explicit
     ``status`` in ``poll()`` results — ``ok | timeout | shed | failed``;
@@ -728,6 +742,8 @@ class ServingConfig(KwargsHandler):
     pad_token_id: Optional[int] = None
     cache_dtype: Any = None
     seed: int = 0
+    speculate_k: int = 0
+    speculate_ngram: int = 16
     max_queue_depth: Optional[int] = None
     overload_policy: str = "reject"
     deadline_s: Optional[float] = None
@@ -772,6 +788,10 @@ class ServingConfig(KwargsHandler):
             )
         if self.journal_segment_records < 1:
             raise ValueError("journal_segment_records must be >= 1")
+        if self.speculate_k < 0:
+            raise ValueError("speculate_k must be >= 0")
+        if self.speculate_ngram < 2:
+            raise ValueError("speculate_ngram must be >= 2")
 
 
 @dataclass

@@ -190,6 +190,19 @@ def main():
                          "a half-mesh disagg engine with an "
                          "AutoscaleController closing the loop; implies "
                          "--serving and --trace diurnal)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="add serving_speculative rows (n-gram self-draft "
+                         "decode, acceptance-friendly vs adversarial "
+                         "traffic, each priced against its non-speculative "
+                         "baseline; implies --serving)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per slot per tick for --speculative")
+    ap.add_argument("--spec-ngram", type=int, default=64,
+                    help="n-gram history window for --speculative")
+    ap.add_argument("--kv-dtype", choices=("model", "int8"), default="model",
+                    help="KV-page dtype for the --disagg row; int8 "
+                         "quantizes pages (QuantPages) and reports the "
+                         "handoff bytes saved")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--qps", type=float, default=8.0,
@@ -212,7 +225,7 @@ def main():
     if args.trace_out:
         args.tracing = True
     if args.disagg or args.chaos or args.publish or args.autoscale \
-            or args.journal or args.sdc or args.fleet:
+            or args.journal or args.sdc or args.fleet or args.speculative:
         args.serving = True
 
     # Streaming-evidence rule (round-3 postmortem, same as bench.py): emit a
@@ -423,6 +436,8 @@ def main():
             "decode_executables": st["decode_executables"],
             "prefill_executables": st["prefill_executables"],
             "steady_recompiles": st["steady_recompiles"],
+            "faults": st["faults"],
+            "speculation": st["speculation"],
         }
         prof_serve.flush()  # finalize the lagged last tick
         row["profile"] = _profile_block(prof_serve)
@@ -430,6 +445,75 @@ def main():
             row["tracing"] = _tracing_block(tr_serve)
             export_tr = tr_serve
         print(json.dumps(row), flush=True)
+
+        # Speculative rows: raw decode throughput with the n-gram
+        # self-draft on, against the non-speculative baseline on the SAME
+        # mesh, model, and request set (everything submitted at t=0 so the
+        # arrival process never caps the measured decode rate). Two traffic
+        # classes: acceptance-friendly uses a Markov-collapsed model
+        # variant (attention output projections zeroed, so continuations
+        # settle into cycles — the repetitive-output regime where n-gram
+        # drafts shine: boilerplate, JSON, copy-heavy completions);
+        # adversarial uses the raw model, whose continuations stay chaotic
+        # and acceptance sits near the floor — the honest worst case.
+        if args.speculative:
+            spec_budget = int(args.new_tokens)
+            spec_cap = int(max(len(r) for r in reqs)) + spec_budget + 8
+
+            def _collapsed_params(tree):
+                new = jax.tree.map(lambda x: x, tree)
+                mp = new["model"] if "model" in new else new
+                blk = mp["layers"]["block"]
+                blk["self_attn"]["o_proj"]["kernel"] = jnp.zeros_like(
+                    blk["self_attn"]["o_proj"]["kernel"])
+                return new
+
+            friendly_model = Model(module=module,
+                                   params=_collapsed_params(res_model.params))
+
+            def _spec_run(mdl, k):
+                ecfg = ServingConfig(
+                    n_slots=slots, max_len=spec_cap,
+                    max_prefill_chunk=max(16, args.prompt_len),
+                    speculate_k=k, speculate_ngram=args.spec_ngram)
+                eng = ServingEngine(mdl, ecfg)
+                eng.warmup()
+                t0 = time.perf_counter()
+                eng.run([r.copy() for r in reqs],
+                        max_new_tokens=spec_budget)
+                wall = time.perf_counter() - t0
+                est = eng.stats()
+                eng.close()
+                return est, wall
+
+            for traffic, mdl in (("acceptance_friendly", friendly_model),
+                                 ("adversarial", res_model)):
+                clear_generation_cache()
+                bst, b_wall = _spec_run(mdl, 0)
+                sst, s_wall = _spec_run(mdl, args.spec_k)
+                b_tps = bst["tokens_out"] / b_wall
+                s_tps = sst["tokens_out"] / s_wall
+                sp = sst["speculation"]
+                print(json.dumps({
+                    "row": "serving_speculative", "traffic": traffic,
+                    "k": args.spec_k, "ngram": args.spec_ngram,
+                    "tokens_per_s": round(s_tps, 2),
+                    "tokens_per_s_baseline": round(b_tps, 2),
+                    "speedup": round(s_tps / b_tps, 3) if b_tps else None,
+                    "acceptance_rate": sp["acceptance_rate"],
+                    "tokens_per_tick": sp["tokens_per_tick"],
+                    "tokens_per_tick_baseline": (
+                        round(bst["tokens_out"] / bst["decode_steps"], 6)
+                        if bst["decode_steps"] else None),
+                    "decode_steps": sst["decode_steps"],
+                    "decode_steps_baseline": bst["decode_steps"],
+                    "decode_executables": sst["decode_executables"],
+                    "steady_recompiles": sst["steady_recompiles"],
+                    "faults": sst["faults"],
+                    "speculation": sp,
+                }), flush=True)
+            friendly_model = None
+            clear_generation_cache()
 
         # Journal rows: the same trace with the crash-durable write-ahead
         # request journal on, one row per fsync policy — the durability tax
@@ -623,8 +707,15 @@ def main():
 
             tr_dis = _recorder()
             prof_dis = DeviceTimeProfiler()
+            dis_cfg = scfg
+            if args.kv_dtype == "int8":
+                dis_cfg = ServingConfig(
+                    n_slots=slots, max_len=t_cap,
+                    max_prefill_chunk=max(16, args.prompt_len),
+                    cache_dtype=jnp.int8)
             dengine = DisaggServingEngine(
-                res_model, scfg, disagg=DisaggConfig(n_prefill_lanes=args.lanes),
+                res_model, dis_cfg,
+                disagg=DisaggConfig(n_prefill_lanes=args.lanes),
                 tracing=tr_dis, profiler=prof_dis,
             )
             dengine.warmup()
@@ -642,6 +733,22 @@ def main():
                 "steady_recompiles": dst["steady_recompiles"],
                 "disagg": dst["disagg"],
             }
+            if args.kv_dtype == "int8":
+                # Byte accounting: what the SAME trace would have moved in
+                # the model's own cache dtype, per the planner's dtype-aware
+                # per-token pricing — the saved fraction is the honest
+                # "4x fewer handoff bytes" number.
+                from accelerate_tpu.planner import kv_bytes_per_token
+
+                moved = int(dst["disagg"]["handoff_bytes"])
+                per_q = kv_bytes_per_token(cfg, dtype=jnp.int8)
+                per_f = kv_bytes_per_token(cfg)
+                unq = int(round(moved * per_f / per_q)) if per_q else None
+                row["kv_dtype"] = "int8"
+                row["handoff_bytes"] = moved
+                row["handoff_bytes_unquantized_est"] = unq
+                row["handoff_bytes_saved_pct"] = (
+                    round(100.0 * (unq - moved) / unq, 2) if unq else None)
             prof_dis.flush()  # finalize the lagged last tick
             row["profile"] = _profile_block(prof_dis)
             if tr_dis is not None:
@@ -695,6 +802,7 @@ def main():
                 "decode_executables": cst["decode_executables"],
                 "steady_recompiles": cst["steady_recompiles"],
                 "faults": cst["faults"],
+                "speculation": cst["speculation"],
             }
             if use_disagg:
                 row["degraded"] = cst["disagg"]["degraded"]

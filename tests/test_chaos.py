@@ -138,6 +138,8 @@ def test_injector_validation():
         "journal_append", "journal_compact", "engine_crash",
         # fleet routing
         "cell_crash", "cell_partition", "router_heartbeat",
+        # speculative decoding + quantized KV pages
+        "draft_mismatch", "page_dequant",
     }
 
 
@@ -463,7 +465,7 @@ def test_off_by_default_no_chaos_no_faults(llama):
         assert res[i]["status"] == "ok"
         assert set(res[i]) == {"id", "status", "tokens", "new_tokens",
                                "ttft_s", "tpot_s", "weights_version",
-                               "attempt", "recovered"}
+                               "attempt", "recovered", "drafted", "accepted"}
         assert res[i]["attempt"] == 1 and res[i]["recovered"] is False
     f = eng.stats()["faults"]
     assert f["injected"] == 0 and f["degraded"] is False

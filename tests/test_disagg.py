@@ -106,6 +106,35 @@ def test_plan_disagg_prices_handoff(llama):
     assert list(d) == sorted(d)  # deterministic artifact ordering
 
 
+def test_plan_disagg_prices_int8_pages_at_half_bf16(llama):
+    # Quantized KV pages move ~half the bytes of bf16 pages (int8 payload
+    # plus one f32 absmax scale per page), and the slice plan's handoff
+    # seconds must reprice accordingly. The method on BandwidthTable and
+    # the module function are the same pricing.
+    cfg, _ = llama
+    bw = BandwidthTable()
+    kvb_bf16 = bw.kv_bytes_per_token(cfg, dtype=np.dtype("bfloat16"))
+    kvb_int8 = bw.kv_bytes_per_token(cfg, dtype=np.int8)
+    assert kvb_bf16 == kv_bytes_per_token(cfg, dtype=np.dtype("bfloat16"))
+    assert kvb_int8 == kv_bytes_per_token(cfg, dtype=np.int8)
+    # "~half": exactly (head_dim + 4) / (2 * head_dim) — the +4-byte f32
+    # absmax scale per page keeps it just over 0.5.
+    from accelerate_tpu.generation import _cache_dims
+
+    _, _, head_dim, _ = _cache_dims(cfg)
+    assert kvb_int8 / kvb_bf16 == (head_dim + 4) / (2 * head_dim)
+    assert kvb_int8 / kvb_bf16 == pytest.approx(0.5, rel=0.15)
+    assert kvb_int8 < kvb_bf16 < kv_bytes_per_token(cfg, dtype=np.float32)
+    p16 = plan_disagg_slices(8, prefill_decode_flop_ratio=2.0, bw=bw,
+                             kv_bytes_per_token=kvb_bf16)
+    p8 = plan_disagg_slices(8, prefill_decode_flop_ratio=2.0, bw=bw,
+                            kv_bytes_per_token=kvb_int8)
+    assert p8.handoff_s_per_ktoken == pytest.approx(
+        0.5 * p16.handoff_s_per_ktoken, rel=0.15)
+    # No dtype override: the config's own dtype prices the link.
+    assert bw.kv_bytes_per_token(cfg) == kv_bytes_per_token(cfg)
+
+
 def test_disagg_config_validation():
     with pytest.raises(ValueError):
         DisaggConfig(n_prefill_lanes=0)

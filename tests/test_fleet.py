@@ -172,7 +172,7 @@ def test_shed_only_when_all_cells_breach(llama, tmp_path):
     # provenance — and pads the prompt to budget like an engine shed.
     assert set(row) == {
         "id", "status", "tokens", "new_tokens", "ttft_s", "tpot_s",
-        "weights_version", "attempt", "recovered",
+        "weights_version", "attempt", "recovered", "drafted", "accepted",
         "cell", "spilled", "drained_from",
     }
     assert row["tokens"].shape == (len(prompts[4]) + 4,)
@@ -265,6 +265,57 @@ def test_cell_crash_drains_exactly_once_and_bit_equal(llama, tmp_path):
     assert np.array_equal(row["tokens"], got["r0"]["tokens"])
     assert router.stats()["completed"] == before
     assert router.stats()["deduped"] == 1
+    router.close()
+
+
+def test_cell_crash_drain_replays_speculative_cells_bit_equal(llama, tmp_path):
+    """Cross-cell drain with speculation on in every cell: the survivor
+    re-executes the dead cell's in-flight requests through its own
+    speculative decode path and every row stays bit-equal to an
+    uninterrupted speculative fleet AND to a non-speculative one (exact
+    verification composes with the drain's rng/idempotency replay)."""
+    cfg, model = llama
+    prompts = _prompts(cfg, [5, 6, 7, 8])
+    spec = dict(speculate_k=2, speculate_ngram=8)
+
+    def run(root, chaos, **kw):
+        router = FleetRouter(
+            {f"c{i}": _mk_cell(model, root / f"wal{i}", **kw)
+             for i in range(2)},
+            chaos=chaos)
+        rids = {}
+        for i, p in enumerate(prompts):
+            rids[f"r{i}"] = router.submit(
+                p, max_new_tokens=6, rng=jax.random.key(i),
+                client_request_id=f"r{i}", session_id=f"sess{i}")
+        rows = _drain_fleet(router)
+        by_cid = {cid: rows[rid] for cid, rid in rids.items()}
+        return router, by_cid
+
+    plain_router, plain = run(tmp_path / "plain", None)
+    plain_router.close()
+    ref_router, ref = run(tmp_path / "ref", None, **spec)
+    ref_router.close()
+
+    chaos = FaultInjector(seed=29, schedule=[
+        {"point": "cell_crash", "kind": "crash", "tick": 1, "unit": 0}])
+    router, got = run(tmp_path / "chaos", chaos, **spec)
+    assert router.cell_states()["c0"] == "dead"
+    assert set(got) == set(ref) == set(plain)
+    for cid in ref:
+        assert got[cid]["status"] == "ok"
+        # Speculation never changes greedy output: chaos == spec ref ==
+        # non-speculative fleet, token for token.
+        assert np.array_equal(got[cid]["tokens"], ref[cid]["tokens"])
+        assert np.array_equal(got[cid]["tokens"], plain[cid]["tokens"])
+    # Requests the survivor re-executed drafted through its own engine.
+    resub = [r for r in got.values()
+             if r["drained_from"] == "c0" or r["cell"] == "c1"]
+    assert any(r["drafted"] > 0 for r in resub)
+    surv = router._cells["c1"].engine
+    assert surv.executable_counts()["decode"] == 1
+    assert surv._stats["steady_recompiles"] == 0
+    assert surv.stats()["speculation"]["drafted"] > 0
     router.close()
 
 

@@ -499,6 +499,58 @@ def test_recover_exactly_once_and_bit_equal(llama, tmp_path):
     assert e2.submit(prompts[0], max_new_tokens=2) > max(ref.values())
 
 
+def test_recover_replays_speculative_requests_bit_equal(llama, tmp_path):
+    """Crash-restart with speculation on: recovered in-flight requests
+    replay bit-equal to a NON-speculative reference (exact-distribution
+    verification holds across the journal replay path too), and the
+    terminal rows carry the drafted/accepted provenance."""
+    cfg, model = llama
+    prompts = _prompts(cfg, [5, 9, 7])
+
+    ref_engine = ServingEngine(
+        model, ServingConfig(n_slots=2, max_len=48, prefill_chunks=[4, 8]))
+    ref = {}
+    for i, p in enumerate(prompts):
+        ref[i] = ref_engine.submit(p, max_new_tokens=8)
+    ref_rows = _drain(ref_engine)
+
+    mk = lambda: ServingConfig(  # noqa: E731
+        n_slots=2, max_len=48, prefill_chunks=[4, 8],
+        speculate_k=2, speculate_ngram=8,
+        journal_dir=str(tmp_path / "wal"))
+    e1 = ServingEngine(model, mk())
+    r0 = e1.submit(prompts[0], max_new_tokens=8, client_request_id="req-0")
+    done = {}
+    ticks = 0
+    while r0 not in done:
+        e1.tick()
+        done.update({r["id"]: r for r in e1.poll()})
+        ticks += 1
+        assert ticks < 500
+    r1 = e1.submit(prompts[1], max_new_tokens=8, client_request_id="req-1")
+    r2 = e1.submit(prompts[2], max_new_tokens=8, client_request_id="req-2")
+    e1.journal.tick_flush()
+    del e1  # abandoned without close(): the crash
+
+    e2 = ServingEngine(model, mk())
+    summary = e2.recover()
+    assert summary["recovered_terminal"] == 1
+    assert summary["recovered_inflight"] == 2
+    rows = {r["id"]: r for r in e2.poll()}
+    np.testing.assert_array_equal(rows[r0]["tokens"], done[r0]["tokens"])
+    rows.update(_drain(e2))
+    for i, rid in ((1, r1), (2, r2)):
+        rec = rows[rid]
+        np.testing.assert_array_equal(rec["tokens"],
+                                      ref_rows[ref[i]]["tokens"])
+        assert rec["status"] == "ok" and rec["recovered"] is True
+        assert rec["drafted"] > 0 and rec["drafted"] >= rec["accepted"]
+    spec = e2.stats()["speculation"]
+    assert spec["k"] == 2 and spec["drafted"] > 0
+    assert e2.stats()["decode_executables"] == 1
+    assert e2.stats()["steady_recompiles"] == 0
+
+
 def test_recover_requires_a_journal(llama):
     cfg, model = llama
     engine = ServingEngine(

@@ -42,7 +42,7 @@ def _prompts(cfg, lengths, seed=3):
 
 POLL_ROW_KEYS = {
     "id", "status", "tokens", "new_tokens", "ttft_s", "tpot_s",
-    "weights_version", "attempt", "recovered",
+    "weights_version", "attempt", "recovered", "drafted", "accepted",
 }
 
 SERVING_STATS_KEYS = {
@@ -54,7 +54,16 @@ SERVING_STATS_KEYS = {
     "prefill_ladder", "n_slots", "mean_occupancy", "peak_occupancy",
     "mean_queue_depth", "slot_allocs", "slot_reuses", "steady_recompiles",
     "decode_executables", "prefill_executables", "weights_version",
-    "canary", "window", "faults", "journal", "sdc",
+    "canary", "window", "faults", "journal", "sdc", "speculation",
+}
+
+# stats()["speculation"] (ServingEngine.speculation_stats): live whether or
+# not speculate_k is set — zeros/None when off, so dashboards key off one
+# shape. Feeds the hub's accelerate_tpu_spec_* series and the
+# serving_speculative bench row.
+SPECULATION_KEYS = {
+    "k", "ngram", "drafted", "accepted", "acceptance_rate",
+    "tokens_per_tick", "verify_time_s",
 }
 
 # The engine ``stats()["sdc"]`` block (DecodeCanary.summary; None when no
@@ -201,6 +210,54 @@ def test_serving_stats_schema(llama):
     assert set(stats["window"]) == WINDOW_KEYS
     assert set(stats["faults"]) == FAULTS_KEYS
     assert stats["journal"] is None  # journaling is off by default
+    assert set(stats["speculation"]) == SPECULATION_KEYS
+    assert stats["speculation"]["k"] == 0  # speculation is off by default
+    assert stats["speculation"]["acceptance_rate"] is None
+
+
+def test_speculation_stats_and_hub_series(llama):
+    """With speculate_k set: the speculation block populates (same pinned
+    shape), poll rows carry real drafted/accepted counts, and a hub wired
+    via telemetry renders the accelerate_tpu_spec_* series floor."""
+    from types import SimpleNamespace
+
+    from accelerate_tpu import MetricsHub
+
+    cfg, model = llama
+    hub = MetricsHub()
+    engine = ServingEngine(
+        model,
+        ServingConfig(n_slots=2, max_len=48, prefill_chunks=[4, 8],
+                      speculate_k=2, speculate_ngram=8),
+        telemetry=SimpleNamespace(hub=hub, record_event=lambda *a, **k: None,
+                                  record_serving=lambda *a, **k: None),
+    )
+    for p in _prompts(cfg, [5, 9]):
+        engine.submit(p, max_new_tokens=8)
+    rows = []
+    while engine.pending:
+        engine.tick()
+        rows.extend(engine.poll())
+    stats = engine.stats()
+    assert set(stats) == SERVING_STATS_KEYS
+    spec = stats["speculation"]
+    assert set(spec) == SPECULATION_KEYS
+    assert spec["k"] == 2 and spec["drafted"] > 0
+    assert spec["acceptance_rate"] is not None
+    assert len(rows) == 2
+    for row in rows:
+        assert set(row) == POLL_ROW_KEYS
+        assert row["drafted"] >= row["accepted"] >= 0
+    assert sum(r["drafted"] for r in rows) == spec["drafted"]
+    names = hub.metric_names()
+    assert {
+        "accelerate_tpu_spec_k",
+        "accelerate_tpu_spec_drafted",
+        "accelerate_tpu_spec_accepted",
+        "accelerate_tpu_spec_acceptance_rate",
+        "accelerate_tpu_spec_tokens_per_tick",
+        "accelerate_tpu_spec_verify_time_s",
+    } <= names, f"missing spec series in {sorted(names)}"
 
 
 def test_journal_stats_schema(llama, tmp_path):

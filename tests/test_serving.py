@@ -333,6 +333,31 @@ def test_accelerator_builds_wired_engine(tmp_path, llama):
     assert "serving_request_done" in kinds and "serving_summary" in kinds
 
 
+def test_serving_summary_acceptance_rate_ema(tmp_path):
+    """record_serving keeps a cross-push EMA of the speculation acceptance
+    rate: first push seeds it, later pushes blend 0.9/0.1, pushes with no
+    rate (speculation off / nothing drafted yet) leave it untouched."""
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = _accelerator(
+        tmp_path, [TelemetryKwargs(straggler_probe_every=0, log_every=0)])
+    tele = acc.telemetry
+    spec = lambda rate: {"speculation": {  # noqa: E731
+        "k": 4, "ngram": 16, "drafted": 100, "accepted": 50,
+        "acceptance_rate": rate, "tokens_per_tick": 1.0, "verify_time_s": 0.1}}
+    tele.record_serving(spec(None))
+    assert tele.summary()["serving"]["speculation"]["acceptance_rate_ema"] is None
+    tele.record_serving(spec(0.5))
+    assert tele.summary()["serving"]["speculation"]["acceptance_rate_ema"] == 0.5
+    tele.record_serving(spec(1.0))
+    got = tele.summary()["serving"]["speculation"]["acceptance_rate_ema"]
+    assert got == pytest.approx(0.9 * 0.5 + 0.1 * 1.0)
+    tele.record_serving(spec(None))  # no new rate: EMA survives unchanged
+    assert (tele.summary()["serving"]["speculation"]["acceptance_rate_ema"]
+            == pytest.approx(0.55))
+    tele.close()
+
+
 def test_generation_signatures_reach_manifest_and_warm(tmp_path, llama):
     """generate(compile_manager=...) buckets the prompt up the seq ladder,
     records the signature, and warmup_generation() replays it into the
